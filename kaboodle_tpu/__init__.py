@@ -1,0 +1,25 @@
+"""kaboodle-tpu: a TPU-native SWIM gossip membership framework.
+
+The reference (serval/kaboodle) is a Rust library where N OS processes gossip over
+UDP multicast, one tick per second (reference: src/kaboodle.rs). This framework
+re-designs the same protocol TPU-first:
+
+- N *simulated* peers live as rows of dense ``[N, N]`` membership tensors.
+- One SWIM tick (reference: kaboodle.rs:746-779) is a pure JAX function
+  ``state[t] -> state[t+1]`` driven by ``jax.lax.scan``.
+- UDP unicast/broadcast become in-memory message matrices; cross-chip delivery is
+  XLA collectives over ICI via ``jax.sharding`` (see ``kaboodle_tpu.parallel``).
+- The CRC-32 mesh fingerprint (kaboodle.rs:71-83) becomes a vectorized reduction
+  with an all-reduce convergence check.
+- A real UDP transport (C++ + ctypes; see ``kaboodle_tpu.transport``) preserves
+  the original 4-peer LAN demo and wire-format interop.
+
+Public API mirrors the reference's ``Kaboodle`` facade (lib.rs:78-369): see
+:class:`kaboodle_tpu.api.Kaboodle`.
+"""
+
+from kaboodle_tpu.config import SwimConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["SwimConfig", "__version__"]

@@ -1,0 +1,93 @@
+"""Protocol configuration: the reference's tunable surface as one hashable config.
+
+The reference hard-codes its constants in ``src/kaboodle.rs`` (wall-clock
+durations) and ``src/discovery.rs``. The simulator is discrete-time, so every
+duration is re-derived in *ticks* with ``PROTOCOL_PERIOD == 1 tick``
+(kaboodle.rs:38). The mapping is documented per-field below.
+
+``SwimConfig`` is a frozen (hashable) dataclass so it can be passed as a static
+argument to ``jax.jit`` — changing a protocol constant recompiles the kernel,
+which is exactly the XLA-friendly behavior we want (constants fold into the
+compiled program).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimConfig:
+    """All SWIM protocol constants plus parity flags for the reference's quirks.
+
+    Reference citations point into /root/reference/src/.
+    """
+
+    # --- timing (reference wall-ms -> ticks, at 1000 ms/tick) ---------------
+    # PROTOCOL_PERIOD = 1000 ms (kaboodle.rs:38). One tick IS the period.
+    # PING_TIMEOUT = 2000 ms (kaboodle.rs:62): how long a peer stays in
+    # WaitingForPing / WaitingForIndirectPing before escalation / removal.
+    ping_timeout_ticks: int = 2
+    # MAX_PEER_SHARE_AGE = 10000 ms (kaboodle.rs:49): only peers heard from
+    # this recently are shared in KnownPeersRequest replies (kaboodle.rs:483-501)
+    # and gossip-learned peers are back-dated by exactly this much so they are
+    # never re-shared (kaboodle.rs:459-470, quirk Q6).
+    max_peer_share_age_ticks: int = 10
+    # REBROADCAST_INTERVAL = 10000 ms (kaboodle.rs:65): re-announce Join while
+    # lonely (kaboodle.rs:228-251).
+    rebroadcast_interval_ticks: int = 10
+
+    # --- fan-outs -----------------------------------------------------------
+    # NUM_INDIRECT_PING_PEERS (kaboodle.rs:52): k proxies per ping-req escalation.
+    num_indirect_ping_peers: int = 3
+    # NUM_CANDIDATE_TARGET_PEERS (kaboodle.rs:57): ping target is a uniform
+    # choice among the 5 longest-unheard Known peers (kaboodle.rs:655-675).
+    num_candidate_target_peers: int = 5
+
+    # --- payload bounds -----------------------------------------------------
+    # The reference trims Join-response KnownPeers payloads until they fit the
+    # 10240-byte receive buffer (kaboodle.rs:373-383), ~300 entries at demo
+    # identity sizes. 0 disables the cap. NOTE (quirk Q12): the reference does
+    # NOT trim KnownPeersRequest replies (kaboodle.rs:483-501), so we only cap
+    # the join-response path.
+    max_share_peers: int = 300
+
+    # --- parity flags for behavioral quirks (SURVEY.md §8) ------------------
+    # Q1/Q11: an inbound datagram marks its *sender* Known (kaboodle.rs:408-415);
+    # a forwarded indirect-ping Ack therefore resurrects the proxy, NOT the
+    # suspect — the suspect's suspicion is not cleared by the indirect path
+    # (kaboodle.rs:417-447). True reproduces the reference; False implements the
+    # SWIM-paper-intended semantics (forwarded ack clears suspicion).
+    faithful_indirect_ack: bool = True
+    # Q3: Failed broadcasts are inert in the reference because the broadcast
+    # source address is never a known member (kaboodle.rs:268-283). True keeps
+    # them inert; False implements the intended removal-on-Failed.
+    faithful_failed_broadcast: bool = True
+
+    # --- simulator-only knobs ----------------------------------------------
+    # Replace all random draws (ping-target choice, proxy choice, broadcast-
+    # reply Bernoulli) with deterministic lowest-index / always-respond picks.
+    # Used for exact oracle-vs-kernel trajectory equality tests; the reference
+    # seeds ChaChaRng from entropy (kaboodle.rs:164) so exact-sequence parity
+    # with Rust is a non-goal (SURVEY.md §7).
+    deterministic: bool = False
+
+    def __post_init__(self) -> None:
+        if self.ping_timeout_ticks < 1:
+            raise ValueError("ping_timeout_ticks must be >= 1")
+        if self.num_indirect_ping_peers < 1:
+            raise ValueError("num_indirect_ping_peers must be >= 1")
+        if self.num_candidate_target_peers < 1:
+            raise ValueError("num_candidate_target_peers must be >= 1")
+
+
+# Reference wire/transport constants, kept for the interop edge (see
+# kaboodle_tpu.transport). Values cited from the reference sources.
+INCOMING_BUFFER_SIZE = 10240  # kaboodle.rs:43
+DISCOVERY_BUFFER_SIZE = 1024  # discovery.rs:16
+DEFAULT_BROADCAST_PORT = 7475  # justfile run2x2 demo port
+IPV6_MULTICAST_GROUP = "ff02::1213:1989"  # networking.rs:86
+PROBE_BACKOFF_START_MS = 1000  # discovery.rs:19
+PROBE_BACKOFF_MULTIPLIER = 1.25  # discovery.rs:22
+PROBE_BACKOFF_CAP_MS = 10000  # discovery.rs:25
+LATENCY_EWMA_MOST_RECENT_WEIGHT = 0.8  # kaboodle.rs:810

@@ -1,0 +1,23 @@
+"""Vectorized TPU ops: CRC-32, fingerprint hashing, masked sampling."""
+
+from kaboodle_tpu.ops.crc32 import crc32, crc32_update_bytes, membership_crc32
+from kaboodle_tpu.ops.hashing import mix32, peer_record_hash, membership_fingerprint
+from kaboodle_tpu.ops.sampling import (
+    choose_one_of_oldest_k,
+    choose_k_members,
+    bernoulli_matrix,
+    broadcast_reply_prob,
+)
+
+__all__ = [
+    "crc32",
+    "crc32_update_bytes",
+    "membership_crc32",
+    "mix32",
+    "peer_record_hash",
+    "membership_fingerprint",
+    "choose_one_of_oldest_k",
+    "choose_k_members",
+    "bernoulli_matrix",
+    "broadcast_reply_prob",
+]

@@ -1,0 +1,143 @@
+"""Vectorized CRC-32 (IEEE, reflected poly 0xEDB88320) for TPU.
+
+The reference fingerprints its membership map with ``crc32fast``
+(kaboodle.rs:71-83): peers sorted by address, then for each peer the CRC is
+updated with the address string bytes and the identity bytes. ``crc32fast``
+computes standard CRC-32 (same as Python's ``zlib.crc32``), so ``zlib`` is the
+test oracle for every kernel here.
+
+TPU design notes:
+- The byte-wise update ``s' = (s >> 8) ^ LUT[(s ^ byte) & 0xFF]`` is sequential
+  in the *byte* axis but embarrassingly parallel across rows — so we lay data
+  out as ``[rows, bytes]`` and ``lax.scan`` over bytes with a 256-entry
+  ``uint32`` table gather per step. For fixed-width records (the simulator's
+  case) the scan length is the record width, not the membership size.
+- The membership fingerprint additionally folds a *masked variable-length*
+  sequence (only members contribute). CRC has no cheap commutative form, so
+  :func:`membership_crc32` scans over the peer axis and uses ``where(mask)`` to
+  skip non-members. That is O(N) sequential steps — fine for parity tests at
+  small N; the production convergence check uses the commutative mix-hash in
+  :mod:`kaboodle_tpu.ops.hashing` instead (SURVEY.md §7 explicitly allows
+  swapping the internal hash and keeping CRC-32 at the interop boundary).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_POLY = 0xEDB88320
+
+
+def _make_table() -> np.ndarray:
+    table = np.zeros(256, dtype=np.uint32)
+    for n in range(256):
+        c = np.uint32(n)
+        for _ in range(8):
+            c = (c >> np.uint32(1)) ^ (np.uint32(_POLY) if c & np.uint32(1) else np.uint32(0))
+        table[n] = c
+    return table
+
+
+# Module-level constant; becomes an XLA constant folded into compiled programs.
+CRC_TABLE = _make_table()
+
+
+def crc32_update_bytes(state: jax.Array, data: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Fold ``data`` bytes into running CRC states, vectorized across rows.
+
+    Args:
+      state: uint32 ``[...]`` running CRC state (pre-inverted, i.e. raw register).
+      data: uint8 ``[..., K]`` bytes to fold, row-aligned with ``state``.
+      mask: optional bool ``[..., K]``; False bytes are skipped (state unchanged).
+
+    Returns uint32 ``[...]`` updated raw CRC register.
+    """
+    table = jnp.asarray(CRC_TABLE)
+    data = data.astype(jnp.uint32)
+
+    def step(s, xs):
+        byte, m = xs
+        idx = (s ^ byte) & jnp.uint32(0xFF)
+        new = (s >> jnp.uint32(8)) ^ table[idx]
+        if m is not None:
+            new = jnp.where(m, new, s)
+        return new, None
+
+    if mask is None:
+        out, _ = jax.lax.scan(lambda s, b: step(s, (b, None)), state, jnp.moveaxis(data, -1, 0))
+        return out
+    out, _ = jax.lax.scan(step, state, (jnp.moveaxis(data, -1, 0), jnp.moveaxis(mask, -1, 0)))
+    return out
+
+
+def crc32(data: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """CRC-32 of each row of ``data`` (uint8 ``[..., K]``) -> uint32 ``[...]``.
+
+    Matches ``zlib.crc32`` on the unmasked bytes of each row.
+    """
+    init = jnp.full(data.shape[:-1], 0xFFFFFFFF, dtype=jnp.uint32)
+    out = crc32_update_bytes(init, data, mask)
+    return out ^ jnp.uint32(0xFFFFFFFF)
+
+
+def record_bytes(peer_ids: jax.Array, identities: jax.Array) -> jax.Array:
+    """Fixed-width byte records for the simulator's CRC-parity fingerprint.
+
+    The simulator's canonical record for peer j is 8 bytes: big-endian uint32
+    peer index followed by big-endian uint32 identity word. (The reference
+    hashes the *address string* + identity bytes, kaboodle.rs:77-79; simulated
+    peers are dense indices, so this fixed-width encoding is the sim-canonical
+    equivalent. Byte-exact interop CRC lives in kaboodle_tpu.transport.)
+
+    Args:
+      peer_ids: int32/uint32 ``[N]``.
+      identities: uint32 ``[N]``.
+    Returns uint8 ``[N, 8]``.
+    """
+    pid = peer_ids.astype(jnp.uint32)
+    idn = identities.astype(jnp.uint32)
+
+    def be_bytes(x):
+        return jnp.stack(
+            [
+                (x >> jnp.uint32(24)) & jnp.uint32(0xFF),
+                (x >> jnp.uint32(16)) & jnp.uint32(0xFF),
+                (x >> jnp.uint32(8)) & jnp.uint32(0xFF),
+                x & jnp.uint32(0xFF),
+            ],
+            axis=-1,
+        )
+
+    return jnp.concatenate([be_bytes(pid), be_bytes(idn)], axis=-1).astype(jnp.uint8)
+
+
+def membership_crc32(member: jax.Array, identities: jax.Array) -> jax.Array:
+    """Order-sensitive CRC-32 fingerprint of each peer's membership row.
+
+    ``fingerprint[i] = crc32(concat over j ascending where member[i, j] of
+    record_bytes(j, identities[j]))`` — the sim-canonical analogue of
+    ``generate_fingerprint`` (kaboodle.rs:71-83): the reference sorts peers by
+    address; here peer identity IS the dense index so ascending index order is
+    the sort order.
+
+    Sequential in N (scan over the peer axis), vectorized across the N rows.
+    Use for parity tests / small N; production uses the commutative mix-hash.
+
+    Args:
+      member: bool ``[N, N]`` (member[i, j]: does i know j).
+      identities: uint32 ``[N]``.
+    Returns uint32 ``[N]``.
+    """
+    n = member.shape[-1]
+    recs = record_bytes(jnp.arange(n, dtype=jnp.uint32), identities)  # [N, 8]
+
+    def step(state, j):
+        m = member[:, j]  # [N] does each row include peer j
+        mask = jnp.broadcast_to(m[:, None], (n, 8))
+        return crc32_update_bytes(state, jnp.broadcast_to(recs[j], (n, 8)), mask), None
+
+    init = jnp.full((n,), 0xFFFFFFFF, dtype=jnp.uint32)
+    out, _ = jax.lax.scan(step, init, jnp.arange(n))
+    return out ^ jnp.uint32(0xFFFFFFFF)

@@ -1,0 +1,63 @@
+"""Commutative membership fingerprint: the production convergence hash.
+
+The reference's fingerprint is an order-sensitive CRC-32 over sorted peers
+(kaboodle.rs:71-83). Its only job is *equality testing* between two peers'
+views of the mesh (kaboodle.rs:68-70 says so explicitly) — so the simulator's
+production hash is a commutative 32-bit sum of per-peer record hashes:
+
+    fingerprint[i] = sum_{j : member[i,j]} mix32(j, identity[j])  (mod 2^32)
+
+Commutativity turns the fingerprint into a masked row-reduction — one
+bandwidth-bound pass over the ``[N, N]`` membership tensor with a precomputed
+``[N]`` vector of record hashes — instead of an O(N)-step sequential CRC scan.
+Equality semantics are preserved (identical membership+identities => identical
+fingerprint; differing views collide with probability ~2^-32). CRC-32 remains
+available for parity tests (ops.crc32) and is byte-exact at the wire-interop
+boundary (kaboodle_tpu.transport).
+
+``mix32`` is the splitmix32 finalizer — a bijective avalanche mixer, the moral
+equivalent of crc32's diffusion for this purpose.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mix32(x: jax.Array) -> jax.Array:
+    """splitmix32 finalizer; bijective on uint32."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def peer_record_hash(peer_ids: jax.Array, identities: jax.Array) -> jax.Array:
+    """32-bit hash of one (peer id, identity) record. uint32 ``[N]``.
+
+    Two mixing rounds so that neither field can cancel the other under the
+    commutative sum.
+    """
+    pid = peer_ids.astype(jnp.uint32)
+    idn = identities.astype(jnp.uint32)
+    return mix32(mix32(pid ^ jnp.uint32(0x9E3779B9)) ^ idn)
+
+
+def membership_fingerprint(member: jax.Array, identities: jax.Array) -> jax.Array:
+    """Commutative fingerprint of each row of the membership tensor.
+
+    Args:
+      member: bool ``[N, N]``; member[i, j] == peer i has peer j in its map.
+      identities: uint32 ``[N]`` identity word per peer.
+    Returns uint32 ``[N]``: fingerprint of each peer's view.
+
+    Replaces ``generate_fingerprint`` (kaboodle.rs:71-83) for on-device use;
+    wraparound uint32 addition == mod 2^32.
+    """
+    h = peer_record_hash(jnp.arange(member.shape[-1], dtype=jnp.uint32), identities)
+    contrib = jnp.where(member, h[None, :], jnp.uint32(0))
+    return jnp.sum(contrib, axis=-1, dtype=jnp.uint32)

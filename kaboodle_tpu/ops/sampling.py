@@ -1,0 +1,130 @@
+"""Masked random-selection primitives for the tick kernel.
+
+The reference makes three kinds of random draws per tick (ChaChaRng,
+kaboodle.rs:164):
+
+1. ping target = uniform choice among the 5 longest-unheard Known peers
+   (``sort -> take(5) -> choose``, kaboodle.rs:661-675);
+2. indirect-ping proxies = ``choose_multiple`` of 3 distinct non-suspected
+   peers (kaboodle.rs:595-597);
+3. broadcast-reply Bernoulli with probability ``max(1, 100 - n^2)/100``
+   (kaboodle.rs:344-353).
+
+Here each draw is a fixed-shape batched op over all N simulated peers at once.
+Exact ChaCha sequence parity is a non-goal (SURVEY.md §7); distributional
+parity is tested in tests/test_sampling.py. Every op has a ``deterministic``
+mode (lowest-index / always-true) used for exact oracle-vs-kernel trajectory
+tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def choose_one_of_oldest_k(
+    timer: jax.Array,
+    eligible: jax.Array,
+    k: int,
+    key: jax.Array,
+    deterministic: bool = False,
+) -> jax.Array:
+    """Per row: uniform choice among the k eligible entries with smallest timer.
+
+    Mirrors ping-target selection (kaboodle.rs:661-675): sort Known peers by
+    last-heard tick, take the oldest ``k``, pick one uniformly. Ties break
+    toward the lower index (top_k is stable), matching the oracle's stable sort.
+
+    Args:
+      timer: int32 ``[N, N]`` last-heard tick (row i's view of peer j).
+      eligible: bool ``[N, N]`` candidate mask (Known, not self).
+      k: NUM_CANDIDATE_TARGET_PEERS.
+      key: PRNG key.
+      deterministic: pick the single oldest instead of randomizing.
+
+    Returns int32 ``[N]``: chosen column per row, or -1 if the row has no
+    eligible entries.
+    """
+    n = timer.shape[-1]
+    k = min(k, n)
+    scores = jnp.where(eligible, timer, _I32_MAX)
+    # top_k of negated scores = k smallest timers, ascending, stable.
+    neg_vals, idx = jax.lax.top_k(-scores, k)  # [N, k]
+    valid = neg_vals != -_I32_MAX
+    count = jnp.sum(valid, axis=-1)  # [N]
+    if deterministic:
+        choice = jnp.zeros(timer.shape[0], dtype=jnp.int32)
+    else:
+        u = jax.random.uniform(key, (timer.shape[0],))
+        choice = jnp.floor(u * count.astype(jnp.float32)).astype(jnp.int32)
+        choice = jnp.minimum(choice, jnp.maximum(count - 1, 0))
+    chosen = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(count > 0, chosen, -1).astype(jnp.int32)
+
+
+def choose_k_members(
+    eligible: jax.Array,
+    k: int,
+    key: jax.Array,
+    deterministic: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Per row: up to ``k`` distinct members of the mask, uniformly at random.
+
+    Mirrors proxy selection for indirect pings (``choose_multiple``,
+    kaboodle.rs:595-597): if a row has fewer than ``k`` eligible entries it
+    returns all of them, like the reference.
+
+    Implementation: Gumbel-top-k over the mask — an exact uniform sample of a
+    size-k subset, fully vectorized (no per-row loops).
+
+    Returns:
+      idx: int32 ``[N, k]`` chosen columns (undefined where not valid).
+      valid: bool ``[N, k]``.
+    """
+    n = eligible.shape[-1]
+    k = min(k, n)
+    if deterministic:
+        scores = jnp.where(eligible, -jnp.arange(n, dtype=jnp.float32)[None, :], -jnp.inf)
+    else:
+        g = jax.random.gumbel(key, eligible.shape, dtype=jnp.float32)
+        scores = jnp.where(eligible, g, -jnp.inf)
+    vals, idx = jax.lax.top_k(scores, k)
+    valid = vals > -jnp.inf
+    return idx.astype(jnp.int32), valid
+
+
+def bernoulli_matrix(
+    key: jax.Array,
+    prob: jax.Array,
+    shape: tuple[int, ...],
+    deterministic: bool = False,
+) -> jax.Array:
+    """Bernoulli draws with per-element (broadcastable) probabilities.
+
+    Used for the broadcast-reply dampening curve (kaboodle.rs:344-353). In
+    deterministic mode every draw with positive probability succeeds (the
+    reference's probabilities are always >= 1%).
+    """
+    if deterministic:
+        return jnp.broadcast_to(prob > 0, shape)
+    u = jax.random.uniform(key, shape)
+    return u < jnp.broadcast_to(prob, shape)
+
+
+def broadcast_reply_prob(num_known: jax.Array) -> jax.Array:
+    """The reply-dampening curve ``max(1, 100 - n^2)/100`` with ``n = len - 2``.
+
+    ``num_known`` is the receiver's membership-map size *including itself*
+    (kaboodle.rs:344: ``num_other_peers = len - 2`` — minus self and sender);
+    ``n <= 0`` means certain reply (kaboodle.rs:345-348).
+    """
+    n_other = num_known.astype(jnp.int32) - 2
+    # The curve hits its 1% floor at n_other = 10; clamp before squaring so the
+    # int32 square cannot overflow at mesh sizes >= 46341 (reference computes
+    # in i64, kaboodle.rs:344-351).
+    n_clamped = jnp.minimum(n_other, 10)
+    pct = jnp.maximum(1, 100 - n_clamped * n_clamped).astype(jnp.float32) / 100.0
+    return jnp.where(n_other <= 0, 1.0, pct)

@@ -1,0 +1,55 @@
+"""The SWIM state machine as data: state codes and message kinds.
+
+This is the shared vocabulary between the three engines:
+- the NumPy/Python oracle (``kaboodle_tpu.oracle``) — readable, O(N) loops;
+- the JAX tick kernel (``kaboodle_tpu.sim``) — vectorized, ``[N, N]`` tensors;
+- the real-network engine over UDP (``kaboodle_tpu.transport``).
+
+Reference mapping (src/structs.rs):
+- ``PeerState::{Known, WaitingForPing, WaitingForIndirectPing}`` (structs.rs:27-41)
+  each carry an ``Instant``; here the state code and the tick-stamp are stored
+  separately (``state`` int8 + ``timer`` int32 in the simulator). A fourth code,
+  NOT_MEMBER, encodes absence from the membership map.
+- Unicast messages ``SwimMessage::{Ping, PingRequest, Ack, KnownPeers,
+  KnownPeersRequest}`` (structs.rs:92-116).
+- Broadcasts ``SwimBroadcast::{Join, Failed, Probe}`` (structs.rs:64-73).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Peer-state codes for the `state[N, N]` tensor: state[i, j] is what peer i
+# believes about peer j. NOT_MEMBER means j is absent from i's membership map.
+NOT_MEMBER = 0
+KNOWN = 1  # PeerState::Known(last_heard)           structs.rs:31
+WAITING_FOR_PING = 2  # PeerState::WaitingForPing(sent_at)   structs.rs:35
+WAITING_FOR_INDIRECT_PING = 3  # PeerState::WaitingForIndirectPing    structs.rs:40
+
+STATE_NAMES = {
+    NOT_MEMBER: "NotMember",
+    KNOWN: "Known",
+    WAITING_FOR_PING: "WaitingForPing",
+    WAITING_FOR_INDIRECT_PING: "WaitingForIndirectPing",
+}
+
+
+class UnicastKind(enum.IntEnum):
+    """SwimMessage variants, in declaration order (structs.rs:94-115).
+
+    The enum ordinal doubles as the bincode variant index for the wire codec.
+    """
+
+    PING = 0
+    PING_REQUEST = 1
+    ACK = 2
+    KNOWN_PEERS = 3
+    KNOWN_PEERS_REQUEST = 4
+
+
+class BroadcastKind(enum.IntEnum):
+    """SwimBroadcast variants, in declaration order (structs.rs:65-73)."""
+
+    JOIN = 0
+    FAILED = 1
+    PROBE = 2

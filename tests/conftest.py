@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run against
+8 virtual CPU devices (the supported JAX pattern for testing pjit/shard_map
+programs). Must run before the first `import jax` anywhere in the test
+process — pytest imports conftest.py first, so doing it here is sufficient.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

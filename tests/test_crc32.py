@@ -1,0 +1,57 @@
+"""CRC-32 kernels vs zlib.crc32 (the same standard CRC crc32fast computes)."""
+
+import zlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from kaboodle_tpu.ops import crc32, membership_crc32
+from kaboodle_tpu.ops.crc32 import crc32_update_bytes, record_bytes
+
+
+def test_crc32_matches_zlib_rows():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(16, 37), dtype=np.uint8)
+    got = np.asarray(crc32(jnp.asarray(data)))
+    want = np.array([zlib.crc32(row.tobytes()) for row in data], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_crc32_empty_and_known_vector():
+    # crc32(b"") == 0; crc32(b"123456789") == 0xCBF43926 (standard check value)
+    data = np.frombuffer(b"123456789", dtype=np.uint8)[None, :]
+    got = np.asarray(crc32(jnp.asarray(data)))
+    assert got[0] == 0xCBF43926
+    empty = jnp.zeros((3, 0), dtype=jnp.uint8)
+    np.testing.assert_array_equal(np.asarray(crc32(empty)), np.zeros(3, dtype=np.uint32))
+
+
+def test_masked_crc32_skips_bytes():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(8, 24), dtype=np.uint8)
+    mask = rng.random((8, 24)) < 0.5
+    init = jnp.full((8,), 0xFFFFFFFF, dtype=jnp.uint32)
+    got = np.asarray(crc32_update_bytes(init, jnp.asarray(data), jnp.asarray(mask))) ^ np.uint32(
+        0xFFFFFFFF
+    )
+    want = np.array(
+        [zlib.crc32(row[m].tobytes()) for row, m in zip(data, mask)], dtype=np.uint32
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_membership_crc32_matches_python_oracle():
+    rng = np.random.default_rng(2)
+    n = 13
+    member = rng.random((n, n)) < 0.6
+    identities = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    got = np.asarray(membership_crc32(jnp.asarray(member), jnp.asarray(identities)))
+
+    recs = np.asarray(
+        record_bytes(jnp.arange(n, dtype=jnp.uint32), jnp.asarray(identities))
+    )
+    want = []
+    for i in range(n):
+        buf = b"".join(recs[j].tobytes() for j in range(n) if member[i, j])
+        want.append(zlib.crc32(buf))
+    np.testing.assert_array_equal(got, np.array(want, dtype=np.uint32))

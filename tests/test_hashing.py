@@ -1,0 +1,55 @@
+"""Commutative fingerprint: equality semantics of the production hash."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kaboodle_tpu.ops import membership_fingerprint, mix32, peer_record_hash
+
+
+def test_identical_views_identical_fingerprints():
+    n = 32
+    rng = np.random.default_rng(0)
+    identities = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    row = rng.random(n) < 0.5
+    member = jnp.asarray(np.tile(row, (n, 1)))
+    fp = np.asarray(membership_fingerprint(member, identities))
+    assert np.all(fp == fp[0])
+
+
+def test_differing_views_differ():
+    n = 64
+    rng = np.random.default_rng(1)
+    identities = jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32))
+    member = np.tile(rng.random(n) < 0.5, (n, 1))
+    member[3, 7] = not member[3, 7]  # one peer's view differs by one entry
+    fp = np.asarray(membership_fingerprint(jnp.asarray(member), identities))
+    assert fp[3] != fp[0]
+    assert np.all(np.delete(fp, 3) == fp[0])
+
+
+def test_identity_change_changes_fingerprint():
+    n = 16
+    identities = jnp.arange(n, dtype=jnp.uint32)
+    member = jnp.ones((n, n), dtype=bool)
+    fp0 = np.asarray(membership_fingerprint(member, identities))
+    identities2 = identities.at[5].set(jnp.uint32(999))
+    fp1 = np.asarray(membership_fingerprint(member, identities2))
+    assert np.all(fp0 != fp1)  # every view includes peer 5
+
+
+def test_record_hash_no_trivial_cancellation():
+    # (id, identity) pairs must not cancel under the commutative sum:
+    # {(a, x), (b, y)} must differ from {(a, y), (b, x)} with overwhelming prob.
+    a = peer_record_hash(jnp.uint32(1), jnp.uint32(10)) + peer_record_hash(
+        jnp.uint32(2), jnp.uint32(20)
+    )
+    b = peer_record_hash(jnp.uint32(1), jnp.uint32(20)) + peer_record_hash(
+        jnp.uint32(2), jnp.uint32(10)
+    )
+    assert int(a) != int(b)
+
+
+def test_mix32_bijective_sample():
+    xs = jnp.arange(100000, dtype=jnp.uint32)
+    ys = np.asarray(mix32(xs))
+    assert len(np.unique(ys)) == len(ys)

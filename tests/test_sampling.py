@@ -1,0 +1,98 @@
+"""Masked sampling ops: eligibility, fan-out bounds, distributional parity."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.ops import (
+    bernoulli_matrix,
+    broadcast_reply_prob,
+    choose_k_members,
+    choose_one_of_oldest_k,
+)
+
+
+def test_choose_one_of_oldest_k_only_oldest_and_eligible():
+    n = 10
+    rng = np.random.default_rng(0)
+    timer = jnp.asarray(rng.integers(0, 100, size=(n, n), dtype=np.int32))
+    eligible = jnp.asarray(rng.random((n, n)) < 0.7)
+    all_chosen = np.asarray(
+        jax.vmap(lambda k: choose_one_of_oldest_k(timer, eligible, 5, k))(
+            jax.random.split(jax.random.key(0), 20)
+        )
+    )
+    for chosen in all_chosen:
+        for i in range(n):
+            elig_i = np.asarray(eligible[i])
+            if not elig_i.any():
+                assert chosen[i] == -1
+                continue
+            assert elig_i[chosen[i]]
+            # chosen must be among the 5 smallest timers of eligible entries
+            cand = sorted(np.asarray(timer[i])[elig_i])[:5]
+            assert np.asarray(timer[i])[chosen[i]] <= cand[-1]
+
+
+def test_choose_one_of_oldest_k_deterministic_picks_oldest():
+    timer = jnp.asarray([[5, 3, 9, 3], [1, 1, 1, 1]], dtype=jnp.int32)
+    eligible = jnp.asarray([[True, True, True, True], [False, True, True, False]])
+    chosen = np.asarray(
+        choose_one_of_oldest_k(timer, eligible, 5, jax.random.key(0), deterministic=True)
+    )
+    assert chosen[0] == 1  # oldest timer=3, tie broken toward lower index
+    assert chosen[1] == 1  # lowest eligible index among ties
+
+
+def test_choose_one_of_oldest_k_uniform_among_candidates():
+    # one row, 5 equal-timer candidates among 8 eligible: draws should cover
+    # exactly the 5 oldest and be roughly uniform.
+    timer = jnp.asarray([[0, 0, 0, 0, 0, 50, 60, 70]], dtype=jnp.int32)
+    eligible = jnp.ones((1, 8), dtype=bool)
+    cs = np.asarray(
+        jax.vmap(lambda k: choose_one_of_oldest_k(timer, eligible, 5, k)[0])(
+            jax.random.split(jax.random.key(0), 600)
+        )
+    )
+    counts = np.bincount(cs, minlength=8)
+    assert counts[5:].sum() == 0
+    assert (counts[:5] > 60).all()  # ~120 each expected
+
+
+def test_choose_k_members_bounds_and_eligibility():
+    n = 12
+    rng = np.random.default_rng(3)
+    eligible = jnp.asarray(rng.random((n, n)) < 0.4)
+    idx, valid = choose_k_members(eligible, 3, jax.random.key(7))
+    idx, valid = np.asarray(idx), np.asarray(valid)
+    for i in range(n):
+        el = np.asarray(eligible[i])
+        assert valid[i].sum() == min(3, el.sum())
+        sel = idx[i][valid[i]]
+        assert len(set(sel.tolist())) == len(sel)  # distinct
+        assert el[sel].all()
+
+
+def test_choose_k_members_uniform_coverage():
+    eligible = jnp.ones((1, 6), dtype=bool)
+    idx, valid = jax.vmap(lambda k: choose_k_members(eligible, 3, k))(
+        jax.random.split(jax.random.key(0), 400)
+    )
+    counts = np.bincount(np.asarray(idx).ravel(), weights=np.asarray(valid).ravel(), minlength=6)
+    # each of 6 columns appears in ~half the draws (3 of 6 chosen)
+    assert (counts > 120).all() and (counts < 280).all()
+
+
+def test_broadcast_reply_prob_curve():
+    # reference: n_other = len-2; <=0 -> 1.0; else max(1, 100-n^2)/100
+    lens = jnp.asarray([1, 2, 3, 4, 7, 12, 1000, 65536], dtype=jnp.int32)
+    p = np.asarray(broadcast_reply_prob(lens))
+    np.testing.assert_allclose(p, [1.0, 1.0, 0.99, 0.96, 0.75, 0.01, 0.01, 0.01])
+
+
+def test_bernoulli_matrix_rate():
+    p = jnp.asarray(0.25)
+    draws = np.asarray(bernoulli_matrix(jax.random.key(0), p, (200, 200)))
+    assert abs(draws.mean() - 0.25) < 0.02
+    det = np.asarray(bernoulli_matrix(jax.random.key(0), p, (4, 4), deterministic=True))
+    assert det.all()

@@ -1,0 +1,42 @@
+"""Reference-faithful per-peer SWIM engine (pure Python, transport-agnostic).
+
+This is the framework's executable spec: a direct, readable implementation of
+the reference protocol engine (src/kaboodle.rs) used as
+- the oracle that the vectorized JAX kernel (kaboodle_tpu.sim) is tested
+  against, via the deterministic lockstep harness in ``lockstep.py``;
+- the state machine behind the real-network UDP transport
+  (kaboodle_tpu.transport), where it runs against a wall clock.
+"""
+
+from kaboodle_tpu.oracle.engine import PeerEngine, PeerRecord, Outbox
+from kaboodle_tpu.oracle.engine import (
+    Ping,
+    PingRequest,
+    Ack,
+    KnownPeersMsg,
+    KnownPeersRequest,
+    Join,
+    Failed,
+    Probe,
+    ProbeResponse,
+)
+from kaboodle_tpu.oracle.fingerprint import mix_fingerprint, crc_fingerprint
+from kaboodle_tpu.oracle.lockstep import LockstepMesh
+
+__all__ = [
+    "PeerEngine",
+    "PeerRecord",
+    "Outbox",
+    "Ping",
+    "PingRequest",
+    "Ack",
+    "KnownPeersMsg",
+    "KnownPeersRequest",
+    "Join",
+    "Failed",
+    "Probe",
+    "ProbeResponse",
+    "mix_fingerprint",
+    "crc_fingerprint",
+    "LockstepMesh",
+]

@@ -1,0 +1,227 @@
+"""Deterministic lockstep mesh: N oracle engines + in-memory transport.
+
+This harness defines the framework's discrete-time delivery model — the
+executable contract that the JAX tick kernel (kaboodle_tpu.sim) reproduces
+tensor-wise. One tick is the reference protocol period (kaboodle.rs:746-779):
+the active half runs first, then request/reply chains resolve *within* the
+tick in a fixed number of delivery rounds, mirroring the reference's reactive
+half where a ping and its ack complete inside one 1-second period
+(kaboodle.rs:762-778). See SEMANTICS.md for the full round model.
+
+Round structure per tick t:
+  A  active phase: join/failed broadcasts + Pings + PingRequests queued
+  B  broadcast delivery (Join/Failed/Probe) -> join-response KnownPeers queued
+  C  delivery: Ping -> Ack queued; PingRequest -> proxy Ping queued
+  D  delivery: Ack (direct), proxy Ping -> Ack queued, join-response KnownPeers
+  E  delivery: proxy's Ack from target -> forwarded Acks queued
+  F  delivery: forwarded Acks
+  G  anti-entropy: each peer resolves <= 1 KnownPeersRequest (deviation D2);
+     request + filtered reply resolve within the round
+Within each round: all sender-marks (Q1) are applied first, then mutating
+messages (Ack, KnownPeers), then reply-generating ones (KnownPeersRequest,
+Ping, PingRequest), each in sender-address order — the same serialization the
+vectorized kernel implements.
+
+Delivery faults (drop masks, partitions, dead peers) gate every delivery via
+``delivery_ok``; a dead peer neither acts, receives, nor replies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.oracle.engine import (
+    Ack,
+    Join,
+    KnownPeersMsg,
+    KnownPeersRequest,
+    Outbox,
+    PeerEngine,
+    Ping,
+    PingRequest,
+    ProbeResponse,
+    addr_key,
+)
+
+# Dispatch ordering within a round: mutators before repliers (see module doc).
+_TYPE_ORDER = {
+    Ack: 0,
+    KnownPeersMsg: 1,
+    KnownPeersRequest: 2,
+    Ping: 3,
+    PingRequest: 4,
+    ProbeResponse: 5,
+}
+
+
+class LockstepMesh:
+    """N simulated peers with integer addresses 0..N-1 gossiping in lockstep."""
+
+    def __init__(
+        self,
+        n: int,
+        cfg: Optional[SwimConfig] = None,
+        identities: Optional[list[int]] = None,
+        delivery_ok: Optional[Callable[[int, int, int], bool]] = None,
+        seed: int = 0,
+        alive: Optional[list[bool]] = None,
+    ) -> None:
+        self.n = n
+        self.cfg = cfg or SwimConfig()
+        self.identities = list(identities) if identities else [i + 1 for i in range(n)]
+        self.tick_count = 0
+        # delivery_ok(sender, receiver, tick) gates unicasts and broadcasts.
+        self.delivery_ok = delivery_ok or (lambda s, r, t: True)
+        self.alive = list(alive) if alive else [True] * n
+        self.engines: list[PeerEngine] = [
+            PeerEngine(i, self.identities[i], self.cfg, now=0, seed=seed * 100003 + i)
+            for i in range(n)
+        ]
+        # Message log of the current tick, for tests/metrics.
+        self.last_tick_messages = 0
+
+    # --- churn ---------------------------------------------------------------
+
+    def kill(self, i: int) -> None:
+        """Silent leave (quirk Q8: stop() does not announce departure)."""
+        self.alive[i] = False
+
+    def revive(self, i: int, identity: Optional[int] = None) -> None:
+        """Rejoin with fresh state: the peer knows only itself and will
+        broadcast Join on its next active phase (kaboodle.rs:228-251)."""
+        if identity is not None:
+            self.identities[i] = identity
+        self.alive[i] = True
+        self.engines[i] = PeerEngine(
+            i, self.identities[i], self.cfg, now=self.tick_count, seed=7 * 100003 + i
+        )
+
+    # --- delivery plumbing ---------------------------------------------------
+
+    def _deliver_round(self, unicasts: list[tuple[int, int, object]], now: int) -> list:
+        """Deliver one round of (sender, dest, msg); returns next round's sends."""
+        delivered: list[tuple[int, int, object]] = []
+        for sender, dest, msg in unicasts:
+            if not (0 <= dest < self.n) or not self.alive[dest] or not self.alive[sender]:
+                continue
+            if not self.delivery_ok(sender, dest, now):
+                continue
+            delivered.append((sender, dest, msg))
+        self.last_tick_messages += len(delivered)
+
+        # Pass 1: marks (Q1), any inbound datagram resurrects its sender.
+        for sender, dest, _msg in delivered:
+            self.engines[dest].mark_sender(sender, self.engines[sender].identity, now)
+
+        # Pass 2: dispatch in (type order, sender order) per receiver.
+        delivered.sort(key=lambda x: (x[1], _TYPE_ORDER[type(x[2])], addr_key(x[0])))
+        next_round: list[tuple[int, int, object]] = []
+        for sender, dest, msg in delivered:
+            out = self.engines[dest].dispatch_unicast(sender, msg, now)
+            next_round.extend((dest, d, m) for d, m in out.unicasts)
+            assert not out.broadcasts
+        return next_round
+
+    def _deliver_broadcasts(self, broadcasts: list[tuple[int, object]], now: int) -> list:
+        """Deliver broadcasts to every alive peer (including the origin, whose
+        engine skips its own Join/Failed, kaboodle.rs:269-273, 285-287)."""
+        next_round: list[tuple[int, int, object]] = []
+        for origin, msg in broadcasts:
+            if not self.alive[origin]:
+                continue
+            for r in range(self.n):
+                if not self.alive[r] or not self.delivery_ok(origin, r, now):
+                    continue
+                # Real broadcasts arrive from the broadcast socket's address,
+                # which is never a member (quirk Q3): origin=None models that.
+                out = self.engines[r].on_broadcast(None, msg, now)
+                next_round.extend((r, d, m) for d, m in out.unicasts)
+                assert not out.broadcasts
+        return next_round
+
+    # --- the tick ------------------------------------------------------------
+
+    def tick(self) -> None:
+        now = self.tick_count
+        self.last_tick_messages = 0
+
+        # A: active phase.
+        broadcasts: list[tuple[int, object]] = []
+        round1: list[tuple[int, int, object]] = []
+        for i, eng in enumerate(self.engines):
+            if not self.alive[i]:
+                continue
+            out = eng.active_phase(now)
+            broadcasts.extend((i, b) for b in out.broadcasts)
+            round1.extend((i, d, m) for d, m in out.unicasts)
+
+        # B: broadcast delivery; join responses land with round 2.
+        join_responses = self._deliver_broadcasts(broadcasts, now)
+
+        # C..F: four unicast delivery rounds resolve the ping / ping-req /
+        # ack / forwarded-ack chains within the tick.
+        round2 = self._deliver_round(round1, now)
+        round3 = self._deliver_round(round2 + join_responses, now)
+        round4 = self._deliver_round(round3, now)
+        leftovers = self._deliver_round(round4, now)
+        # The chain is at most 4 deep (ping-req -> proxy ping -> ack ->
+        # forwarded ack); anything further would break kernel parity.
+        assert not leftovers, f"unexpected round-5 messages: {leftovers}"
+
+        # G: anti-entropy resolution (deviation D2: <= 1 request per peer).
+        requests: list[tuple[int, int, KnownPeersRequest]] = []
+        for i, eng in enumerate(self.engines):
+            if not self.alive[i]:
+                eng._sync_candidates = []
+                continue
+            req = eng.take_sync_request()
+            if req is not None:
+                partner, msg = req
+                requests.append((i, partner, msg))
+        replies = self._deliver_round(requests, now)
+        final = self._deliver_round(replies, now)
+        assert all(isinstance(m, KnownPeersMsg) for (_, _, m) in replies)
+        assert not final
+
+        # D3: curious-peer relay entries do not outlive the tick (the kernel
+        # resolves the whole indirect-ping chain in-tick and stores nothing).
+        for eng in self.engines:
+            eng.curious.clear()
+
+        self.tick_count += 1
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            self.tick()
+
+    # --- observers -----------------------------------------------------------
+
+    def fingerprints(self) -> list[int]:
+        return [e.fingerprint() for e in self.engines]
+
+    def converged(self) -> bool:
+        """All alive peers agree on the mesh fingerprint (the reference's
+        convergence signal, README.md:19-29)."""
+        fps = {e.fingerprint() for e, a in zip(self.engines, self.alive) if a}
+        return len(fps) <= 1
+
+    def state_matrix(self) -> np.ndarray:
+        """int8 [N, N] of spec state codes: row i = peer i's view."""
+        m = np.zeros((self.n, self.n), dtype=np.int8)
+        for i, eng in enumerate(self.engines):
+            for a, rec in eng.known.items():
+                if isinstance(a, int) and 0 <= a < self.n:
+                    m[i, a] = rec.state
+        return m
+
+    def timer_matrix(self) -> np.ndarray:
+        """int32 [N, N] of state timestamps (ticks); 0 where not a member."""
+        m = np.zeros((self.n, self.n), dtype=np.int32)
+        for i, eng in enumerate(self.engines):
+            for a, rec in eng.known.items():
+                if isinstance(a, int) and 0 <= a < self.n:
+                    m[i, a] = int(rec.since)
+        return m

@@ -1,0 +1,284 @@
+"""Oracle engine + lockstep mesh: convergence, failure detection, quirks."""
+
+import dataclasses
+
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.oracle import (
+    Ack,
+    Join,
+    KnownPeersMsg,
+    KnownPeersRequest,
+    LockstepMesh,
+    PeerEngine,
+    Ping,
+    PingRequest,
+    mix_fingerprint,
+)
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+
+
+def test_four_peer_convergence():
+    """BASELINE config 1 analogue: 4 peers join and converge."""
+    mesh = LockstepMesh(4)
+    for _ in range(6):
+        mesh.tick()
+        if mesh.converged() and all(e.num_peers() == 4 for e in mesh.engines):
+            break
+    assert mesh.converged()
+    assert all(e.num_peers() == 4 for e in mesh.engines)
+
+
+def test_convergence_64_peers():
+    mesh = LockstepMesh(64, seed=3)
+    for _ in range(12):
+        mesh.tick()
+        if mesh.converged() and mesh.engines[0].num_peers() == 64:
+            break
+    assert mesh.converged()
+    assert all(e.num_peers() == 64 for e in mesh.engines)
+
+
+def test_fingerprint_matches_ops_kernel():
+    """Oracle mix fingerprint must be bit-exact with the JAX reduction."""
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.ops import membership_fingerprint
+
+    mesh = LockstepMesh(16, seed=1)
+    mesh.run(4)
+    member = mesh.state_matrix() > 0
+    ids = jnp.asarray(np.array(mesh.identities, dtype=np.uint32))
+    kernel_fp = np.asarray(membership_fingerprint(jnp.asarray(member), ids))
+    oracle_fp = np.array(mesh.fingerprints(), dtype=np.uint32)
+    np.testing.assert_array_equal(kernel_fp, oracle_fp)
+
+
+def test_failure_detection_and_reconvergence():
+    """Silent leave (Q8) is detected via ping timeout -> indirect ping ->
+    removal (kaboodle.rs:558-653), then fingerprints re-converge."""
+    mesh = LockstepMesh(8, seed=2)
+    mesh.run(8)
+    assert mesh.converged()
+    mesh.kill(5)
+    for t in range(30):
+        mesh.tick()
+        gone = all(
+            5 not in e.known for i, e in enumerate(mesh.engines) if mesh.alive[i]
+        )
+        if gone and mesh.converged():
+            break
+    assert gone
+    assert mesh.converged()
+    assert all(e.num_peers() == 7 for i, e in enumerate(mesh.engines) if mesh.alive[i])
+
+
+def test_rejoin_after_failure():
+    mesh = LockstepMesh(6, seed=4)
+    mesh.run(6)
+    mesh.kill(2)
+    # Detection completeness bound is ~2N ticks (kaboodle.rs:656-660), plus
+    # gossip echo can re-insert a removed peer until every direct entry ages
+    # past MAX_PEER_SHARE_AGE (quirk Q6 stops re-sharing after that).
+    for _ in range(40):
+        mesh.tick()
+        if all(2 not in e.known for i, e in enumerate(mesh.engines) if mesh.alive[i]):
+            break
+    assert all(2 not in e.known for i, e in enumerate(mesh.engines) if mesh.alive[i])
+    mesh.revive(2)
+    for _ in range(15):
+        mesh.tick()
+        if mesh.converged() and all(
+            e.num_peers() == 6 for i, e in enumerate(mesh.engines) if mesh.alive[i]
+        ):
+            break
+    assert mesh.converged()
+    assert all(e.num_peers() == 6 for i, e in enumerate(mesh.engines) if mesh.alive[i])
+
+
+def test_deterministic_mode_reproducible():
+    cfg = SwimConfig(deterministic=True)
+    a = LockstepMesh(12, cfg=cfg)
+    b = LockstepMesh(12, cfg=cfg, seed=99)  # engine RNG seeds must not matter
+    a.run(10)
+    b.run(10)
+    np.testing.assert_array_equal(a.state_matrix(), b.state_matrix())
+    np.testing.assert_array_equal(a.timer_matrix(), b.timer_matrix())
+
+
+def test_short_partition_heals():
+    """A partition shorter than the removal pipeline heals: surviving
+    cross-half entries get re-pinged, suspicion clears on the first inbound
+    datagram (Q1), and anti-entropy repairs any divergence."""
+    state = {"partitioned": False}
+
+    def delivery_ok(s, r, t):
+        if state["partitioned"]:
+            return (s < 4) == (r < 4)
+        return True
+
+    mesh = LockstepMesh(8, delivery_ok=delivery_ok, seed=5)
+    mesh.run(8)
+    assert mesh.converged()
+    state["partitioned"] = True
+    mesh.run(3)  # shorter than WFP->WFI->removal (2 x ping_timeout)
+    state["partitioned"] = False
+    for _ in range(40):
+        mesh.tick()
+        if mesh.converged() and mesh.engines[0].num_peers() == 8:
+            break
+    assert mesh.converged()
+    assert all(e.num_peers() == 8 for e in mesh.engines)
+
+
+def test_long_partition_splits_permanently_until_new_join():
+    """Faithful reference behavior: after both halves fully remove each other
+    there is NO reconnection mechanism (Join rebroadcast requires loneliness,
+    kaboodle.rs:228-251) — the meshes stay split until some peer (re)joins and
+    its Join broadcast bridges them."""
+    state = {"partitioned": True}
+
+    def delivery_ok(s, r, t):
+        if state["partitioned"]:
+            return (s < 4) == (r < 4)
+        return True
+
+    mesh = LockstepMesh(8, delivery_ok=delivery_ok, seed=5)
+    mesh.run(40)  # converge within halves; cross-half members fully expire
+    assert {i for i in mesh.engines[0].known} == {0, 1, 2, 3}
+    assert {i for i in mesh.engines[7].known} == {4, 5, 6, 7}
+    state["partitioned"] = False
+    mesh.run(20)
+    # still split: no one is lonely, so no Join broadcasts fire
+    assert {i for i in mesh.engines[0].known} == {0, 1, 2, 3}
+    # a fresh join bridges the halves: everyone hears the broadcast
+    mesh.kill(0)
+    mesh.revive(0)
+    for _ in range(60):
+        mesh.tick()
+        if mesh.converged() and mesh.engines[0].num_peers() == 8:
+            break
+    assert mesh.converged()
+    assert all(e.num_peers() == 8 for e in mesh.engines)
+
+
+# --- quirk-level unit tests --------------------------------------------------
+
+
+def _engine(addr=0, cfg=None, **kw):
+    return PeerEngine(addr, 100 + addr, cfg or SwimConfig(), now=0, **kw)
+
+
+def test_q1_any_datagram_clears_suspicion():
+    e = _engine(0)
+    e.known[7] = dataclasses.replace(e.known[0], state=WAITING_FOR_PING, since=0)
+    e.on_unicast(7, 107, Ping(), now=1)
+    assert e.known[7].state == KNOWN
+    assert e.known[7].since == 1
+
+
+def test_q11_forwarded_ack_does_not_clear_suspect_faithful():
+    """kaboodle.rs:408-415 + 417-447: the forwarded Ack resurrects the proxy
+    (sender), not the suspect named inside the Ack."""
+    e = _engine(0)
+    e.known[5] = dataclasses.replace(e.known[0], state=WAITING_FOR_INDIRECT_PING, since=0)
+    # proxy 3 forwards an ack about suspect 5
+    e.on_unicast(3, 103, Ack(peer=5, mesh_fingerprint=1, num_peers=3), now=1)
+    assert e.known[5].state == WAITING_FOR_INDIRECT_PING  # still suspected
+    assert e.known[3].state == KNOWN  # proxy resurrected
+
+
+def test_q11_intended_mode_clears_suspect():
+    e = _engine(0, cfg=SwimConfig(faithful_indirect_ack=False))
+    e.known[5] = dataclasses.replace(e.known[0], state=WAITING_FOR_INDIRECT_PING, since=0)
+    e.on_unicast(3, 103, Ack(peer=5, mesh_fingerprint=1, num_peers=3), now=1)
+    assert e.known[5].state == KNOWN
+
+
+def test_q5_join_share_includes_self_no_age_filter():
+    e = _engine(0)
+    e.known[1] = dataclasses.replace(e.known[0], since=-100)  # ancient
+    out = e.on_broadcast(None, Join(2, 102), now=0)
+    assert len(out.unicasts) == 1
+    dest, msg = out.unicasts[0]
+    assert dest == 2
+    shared = dict(msg.peers)
+    assert 0 in shared and 1 in shared  # self included, no age filter
+
+
+def test_kpr_reply_filters_age_self_requester():
+    """kaboodle.rs:483-501: Known-state only, < MAX_PEER_SHARE_AGE, excludes
+    self and requester."""
+    e = _engine(0)
+    now = 20
+    e.known[1] = dataclasses.replace(e.known[0], state=KNOWN, since=now - 3)
+    e.known[2] = dataclasses.replace(e.known[0], state=KNOWN, since=now - 15)  # too old
+    e.known[3] = dataclasses.replace(e.known[0], state=WAITING_FOR_PING, since=now - 1)
+    e.known[4] = dataclasses.replace(e.known[0], state=KNOWN, since=now - 1)
+    out = e.on_unicast(4, 104, KnownPeersRequest(mesh_fingerprint=1, num_peers=9), now=now)
+    (dest, msg), = out.unicasts
+    assert dest == 4
+    shared = dict(msg.peers)
+    assert set(shared) == {1}  # not self(0), not stale(2), not suspected(3), not requester(4)
+
+
+def test_q6_gossip_inserts_backdated():
+    e = _engine(0)
+    now = 30
+    e.on_unicast(1, 101, KnownPeersMsg(((9, 109),)), now=now)
+    assert e.known[9].since == now - SwimConfig().max_peer_share_age_ticks
+    # ... so peer 9 is never re-shared in a KnownPeersRequest reply:
+    out = e.on_unicast(2, 102, KnownPeersRequest(0, 1), now=now)
+    (_, msg), = [u for u in out.unicasts if isinstance(u[1], KnownPeersMsg)]
+    assert 9 not in dict(msg.peers)
+
+
+def test_sync_request_fires_only_when_behind():
+    """kaboodle.rs:707-740: KPR sent iff fingerprints differ and our map is
+    not larger than theirs."""
+    e = _engine(0)
+    e.known[1] = dataclasses.replace(e.known[0], identity=101)
+    fp = e.fingerprint()
+    # same fingerprint -> no request
+    e.on_unicast(1, 101, Ack(1, fp, 2), now=1)
+    assert e.take_sync_request() is None
+    # different fingerprint, they know more -> request
+    e.on_unicast(1, 101, Ack(1, fp ^ 0xDEAD, 5), now=1)
+    partner, req = e.take_sync_request()
+    assert partner == 1 and req.num_peers == e.num_peers()
+    # different fingerprint, we know more -> they should ask us
+    e.on_unicast(1, 101, Ack(1, fp ^ 0xBEEF, 1), now=1)
+    assert e.take_sync_request() is None
+
+
+def test_pingrequest_relays_and_records_curious():
+    e = _engine(2)
+    out = e.on_unicast(0, 100, PingRequest(target=7), now=1)
+    assert (7, Ping()) in [(d, m) for d, m in out.unicasts]
+    assert e.curious[7] == [0]
+    # target acks -> forward to requester
+    out = e.on_unicast(7, 107, Ack(7, 42, 3), now=1)
+    fwd = [(d, m) for d, m in out.unicasts if isinstance(m, Ack)]
+    assert fwd == [(0, Ack(7, 42, 3))]
+    assert 7 not in e.curious
+
+
+def test_detection_latency_bounds():
+    """Failure-detection latency: ~2-4 ticks after last contact for the peer
+    that suspects first (BASELINE.md: 2 x PING_TIMEOUT within >= 1 tick each)."""
+    cfg = SwimConfig(deterministic=True)
+    mesh = LockstepMesh(3, cfg=cfg)
+    mesh.run(5)
+    assert mesh.converged()
+    mesh.kill(2)
+    t_kill = mesh.tick_count
+    removed_at = None
+    for _ in range(12):
+        mesh.tick()
+        if all(2 not in mesh.engines[i].known for i in (0, 1)):
+            removed_at = mesh.tick_count
+            break
+    assert removed_at is not None
+    # ping at t, escalate at t+2, remove at t+4 => within ~4-8 ticks of kill
+    assert removed_at - t_kill <= 8

@@ -27,6 +27,9 @@ Documented deviations from the reference (see PARITY.md):
   the tick's sync candidates in arrival order.
 - D3: curious-peer (indirect-ping relay) entries live for one tick instead of
   lingering until an eventual ack.
+- D7: a forwarded Ack never re-forwards (one relay hop per tick), bounding the
+  in-tick delivery chain at four calls. The reference's reactive loop would
+  relay again if time remained in the period (kaboodle.rs:762-778).
 """
 
 from __future__ import annotations
@@ -58,6 +61,11 @@ class Ack:  # SwimMessage::Ack (structs.rs:103-107)
     peer: object
     mesh_fingerprint: int
     num_peers: int
+    # D7: set on curious-peer relays; a forwarded Ack never re-forwards (the
+    # reference's real-time loop would relay again if time remained in the
+    # period, kaboodle.rs:762-778 — the lockstep model caps the relay at one
+    # hop so the chain depth is fixed at four delivery calls).
+    forwarded: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,7 +140,7 @@ def addr_key(addr: object):
 
 
 def _default_fingerprint(members: dict) -> int:
-    return mix_fingerprint({a: r for a, r in members.items()})
+    return mix_fingerprint(members)
 
 
 class PeerEngine:
@@ -341,9 +349,10 @@ class PeerEngine:
     def dispatch_unicast(self, sender: object, msg: object, now: float) -> Outbox:
         out = Outbox()
         if isinstance(msg, Ack):
-            observers = self.curious.pop(msg.peer, [])
+            # D7: only first-generation Acks pop-and-forward the curious list.
+            observers = [] if msg.forwarded else self.curious.pop(msg.peer, [])
             for observer in observers:  # forward to curious peers (kaboodle.rs:423-443)
-                out.send(observer, Ack(msg.peer, msg.mesh_fingerprint, msg.num_peers))
+                out.send(observer, Ack(msg.peer, msg.mesh_fingerprint, msg.num_peers, forwarded=True))
             if not self.cfg.faithful_indirect_ack and msg.peer in self.known:
                 # Intended-SWIM mode: a forwarded ack clears the suspect too.
                 rec = self.known[msg.peer]

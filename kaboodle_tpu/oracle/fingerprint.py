@@ -56,17 +56,39 @@ def default_addr_bytes(addr) -> bytes:
     return str(addr).encode()
 
 
+def socket_addr_sort_key(addr: object):
+    """Rust ``SocketAddr`` ``Ord`` order (the sort of kaboodle.rs:72-73):
+    V4 variants before V6, then IP numerically (big-endian octets), then port.
+    Integers (simulated peers) sort first, numerically; unparseable addresses
+    fall back to their string form, after all real sockets."""
+    if isinstance(addr, int):
+        return (0, 0, b"", addr)
+    s = str(addr)
+    try:
+        import ipaddress
+
+        if s.startswith("["):  # "[v6]:port"
+            host, port = s[1:].rsplit("]:", 1)
+        else:
+            host, port = s.rsplit(":", 1)
+        ip = ipaddress.ip_address(host)
+        return (1, ip.version, ip.packed, int(port))
+    except ValueError:
+        return (2, 0, s.encode(), 0)
+
+
 def crc_fingerprint(
     members: Mapping[object, bytes],
     addr_bytes: Callable[[object], bytes] = default_addr_bytes,
 ) -> int:
     """Reference-exact fingerprint: CRC-32 over sorted (addr, identity) records.
 
-    ``members`` maps address -> identity bytes. Sorting is by the address's
-    natural order (the reference sorts SocketAddrs, kaboodle.rs:72-73).
+    ``members`` maps address -> identity bytes. Sorting matches Rust's
+    ``SocketAddr`` ordering (the reference sorts SocketAddrs, kaboodle.rs:72-73)
+    — numeric IP order, not lexicographic strings.
     """
     crc = 0
-    for addr in sorted(members.keys(), key=lambda a: (str(type(a)), a)):
+    for addr in sorted(members.keys(), key=socket_addr_sort_key):
         crc = zlib.crc32(addr_bytes(addr), crc)
         ident = members[addr]
         if isinstance(ident, int):

@@ -35,10 +35,8 @@ import numpy as np
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.oracle.engine import (
     Ack,
-    Join,
     KnownPeersMsg,
     KnownPeersRequest,
-    Outbox,
     PeerEngine,
     Ping,
     PingRequest,
@@ -73,6 +71,7 @@ class LockstepMesh:
         self.cfg = cfg or SwimConfig()
         self.identities = list(identities) if identities else [i + 1 for i in range(n)]
         self.tick_count = 0
+        self._seed = seed
         # delivery_ok(sender, receiver, tick) gates unicasts and broadcasts.
         self.delivery_ok = delivery_ok or (lambda s, r, t: True)
         self.alive = list(alive) if alive else [True] * n
@@ -95,8 +94,15 @@ class LockstepMesh:
         if identity is not None:
             self.identities[i] = identity
         self.alive[i] = True
+        # Fresh RNG stream derived from the mesh seed, disjoint from the
+        # constructor's streams (seed*100003 + i, i < n) and from earlier
+        # revivals of the same peer.
         self.engines[i] = PeerEngine(
-            i, self.identities[i], self.cfg, now=self.tick_count, seed=7 * 100003 + i
+            i,
+            self.identities[i],
+            self.cfg,
+            now=self.tick_count,
+            seed=self._seed * 100003 + (self.tick_count + 1) * self.n + i,
         )
 
     # --- delivery plumbing ---------------------------------------------------
@@ -106,6 +112,10 @@ class LockstepMesh:
         delivered: list[tuple[int, int, object]] = []
         for sender, dest, msg in unicasts:
             if not (0 <= dest < self.n) or not self.alive[dest] or not self.alive[sender]:
+                continue
+            if dest == sender:
+                # D8: the in-memory transport does not loop back self-sends
+                # (reachable only via a manual self-ping; real UDP would).
                 continue
             if not self.delivery_ok(sender, dest, now):
                 continue

@@ -1,0 +1,16 @@
+"""Vectorized lockstep SWIM simulator: the TPU tick kernel and its runners."""
+
+from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, init_state, idle_inputs
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import simulate, run_until_converged
+
+__all__ = [
+    "MeshState",
+    "TickInputs",
+    "TickMetrics",
+    "init_state",
+    "idle_inputs",
+    "make_tick_fn",
+    "simulate",
+    "run_until_converged",
+]

@@ -1,0 +1,490 @@
+"""The vectorized SWIM tick kernel: state[t] -> state[t+1] as one pure function.
+
+This is the TPU-native re-expression of the reference's protocol loop
+(kaboodle.rs:746-786): where the reference runs one tokio task per OS process
+per peer, here all N peers advance together, one tick per kernel invocation,
+with every per-peer branch turned into a masked tensor op. The kernel is the
+executable twin of :class:`kaboodle_tpu.oracle.lockstep.LockstepMesh` — the
+round structure below mirrors its docstring, and
+``tests/test_kernel_parity.py`` pins exact state equality per tick in
+deterministic mode.
+
+Round structure per tick t (lockstep.py round letters):
+  A  active phase (kaboodle.rs:746-757): Join broadcasts, suspicion handling
+     (escalation to indirect ping / removals), random ping, manual pings.
+  B  broadcast delivery: Join inserts at every receiver + join-response
+     KnownPeers queued (kaboodle.rs:256-311).
+  1  call 1: deliver active-phase Pings + PingRequests; Acks + proxy Pings
+     queued (kaboodle.rs:513-545).
+  2  call 2: deliver direct Acks, proxy Pings, join responses; target Acks
+     queued; gossip-learned peers inserted back-dated (Q6, kaboodle.rs:448-472).
+  3  call 3: deliver targets' Acks to proxies; forwarded Acks queued to the
+     curious suspectors (kaboodle.rs:418-447).
+  4  call 4: deliver forwarded Acks.
+  G  anti-entropy: each peer resolves <= 1 KnownPeersRequest (deviation D2,
+     kaboodle.rs:707-740); request + filtered reply resolve within the tick.
+
+Within each delivery call, all sender-marks (Q1: any inbound datagram marks
+its sender Known(now), kaboodle.rs:408-415) apply before any dispatch — the
+same serialization the lockstep oracle implements with its two-pass
+``_deliver_round``.
+
+Documented deviations beyond the oracle's D1-D3 (see PARITY.md):
+- D5: when a join-response share exceeds ``max_share_peers``, the kernel caps
+  to the lowest-index members of the responder's start-of-round map (the
+  oracle trims the exact per-joiner snapshot). Inactive when N <= cap.
+- D6: in random (non-deterministic) mode, the join-reply Bernoulli probability
+  uses the exact sequential map size (a cumulative sum over joiners, matching
+  kaboodle.rs:344-353 processing order), but the random draws themselves are
+  counter-based `jax.random`, so random-mode parity with the oracle is
+  distributional, not samplewise.
+
+Memory/layout notes (TPU):
+- ``state`` int8 and ``timer`` int32 are the only [N, N] residents; every
+  message "queue" is O(N) or O(N·k) (the per-tick fan-outs are bounded by the
+  protocol: 1 ping, k=3 ping-reqs, 1 anti-entropy request per peer).
+- The only O(N^3) work is the join-response gossip union, expressed as two
+  int8 matmuls (MXU-friendly) and skipped via ``lax.cond`` on ticks with no
+  Join broadcast.
+- Everything is static-shaped; the whole tick jits into one XLA program and
+  rolls under ``lax.scan`` (runner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import peer_record_hash
+from kaboodle_tpu.ops.sampling import (
+    bernoulli_matrix,
+    broadcast_reply_prob,
+    choose_k_members,
+    choose_one_of_oldest_k,
+)
+from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _fingerprint_and_count(member: jax.Array, rec_hash: jax.Array):
+    """Row fingerprints (commutative mix-hash) + row membership counts."""
+    contrib = jnp.where(member, rec_hash[None, :], jnp.uint32(0))
+    fp = jnp.sum(contrib, axis=-1, dtype=jnp.uint32)
+    n = jnp.sum(member, axis=-1, dtype=jnp.int32)
+    return fp, n
+
+
+def _bool_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Boolean OR-matmul: (a @ b) > 0 with int8 inputs, int32 accumulation.
+
+    int8 x int8 -> int32 rides the MXU on TPU (v5e runs int8 at 2x bf16)."""
+    acc = jax.lax.dot_general(
+        a.astype(jnp.int8),
+        b.astype(jnp.int8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc > 0
+
+
+def _scatter_or(dst: jax.Array, rows: jax.Array, cols: jax.Array, val: jax.Array) -> jax.Array:
+    """dst[rows, cols] |= val with -1-safe indices (val must be False there)."""
+    return dst.at[jnp.clip(rows, 0), jnp.clip(cols, 0)].max(val)
+
+
+def _gather_edge(mat: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
+    """mat[rows, cols] with -1-safe (clipped) indices."""
+    return mat[jnp.clip(rows, 0), jnp.clip(cols, 0)]
+
+
+def make_tick_fn(
+    cfg: SwimConfig,
+    faulty: bool = True,
+) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
+    """Build the jittable tick function for a given protocol config.
+
+    ``cfg`` is baked in (static): protocol constants fold into the compiled
+    program. ``faulty=False`` compiles out the churn/partition/drop paths for
+    the fault-free fast path (bench configs 2 and 4).
+    """
+
+    det = cfg.deterministic
+
+    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:
+        n = st.state.shape[-1]
+        t = st.tick
+        idx = jnp.arange(n, dtype=jnp.int32)
+        eye = idx[:, None] == idx[None, :]
+        key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
+
+        S, T = st.state, st.timer
+        alive, never_b, last_b = st.alive, st.never_broadcast, st.last_broadcast
+
+        # ---- churn: silent kill (Q8) + revive-with-reset (lockstep.revive) ----
+        if faulty:
+            alive = (alive & ~inp.kill) | inp.revive
+            rv = inp.revive
+            S = jnp.where(rv[:, None], jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)), S)
+            T = jnp.where(rv[:, None], jnp.where(eye, t, 0), T)
+            never_b = never_b | rv
+        else:
+            rv = jnp.zeros((n,), dtype=bool)
+
+        # ---- delivery gate for every message this tick ------------------------
+        # ok[s, d]: sender alive, receiver alive, same partition group, not
+        # dropped. The lockstep oracle's ``delivery_ok`` + aliveness checks.
+        ok = alive[:, None] & alive[None, :]
+        if faulty:
+            ok &= inp.partition[:, None] == inp.partition[None, :]
+            if inp.drop_ok is not None:
+                ok &= inp.drop_ok
+            else:
+                keep = jax.random.uniform(key_drop, (n, n)) >= inp.drop_rate
+                ok &= keep
+
+        member0 = S > 0
+        row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
+        rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
+
+        # ================= A. Active phase (kaboodle.rs:746-757) ==============
+        # A1: maybe_broadcast_join (kaboodle.rs:228-251): first call always
+        # broadcasts; afterwards only while lonely and rebroadcast-interval old.
+        lonely = row_count0 <= 1
+        join_b = alive & (
+            never_b | (lonely & ((t - last_b) >= cfg.rebroadcast_interval_ticks))
+        )
+        last_b = jnp.where(join_b, t, last_b)
+        never_b = never_b & ~join_b
+
+        # A2: handle_suspected_peers (kaboodle.rs:558-653) on the pre-tick
+        # snapshot (the oracle iterates a snapshot taken at entry).
+        S0, T0 = S, T
+        age0 = t - T0
+        timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (age0 >= cfg.ping_timeout_ticks)
+        has_timed = jnp.any(timed_wfp, axis=-1)
+        # D1: escalate exactly one — the oldest, ties toward the lower index.
+        tsel = jnp.where(timed_wfp, T0, _I32MAX)
+        min_t = jnp.min(tsel, axis=-1)
+        jstar_mask = timed_wfp & (T0 == min_t[:, None])
+        jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
+        jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
+
+        # Proxy candidates: Known peers other than self, from the same snapshot
+        # (kaboodle.rs:595-605; the suspect itself is WaitingForPing, excluded).
+        known_cand = (S0 == KNOWN) & ~eye
+        has_cand = jnp.any(known_cand, axis=-1)
+        escalate = has_timed & has_cand
+        insta_remove = has_timed & ~has_cand  # no proxies -> drop now (:599-605)
+
+        proxies, proxies_valid = choose_k_members(
+            known_cand, cfg.num_indirect_ping_peers, key_proxy, det
+        )  # [N, k]
+        proxies_valid &= escalate[:, None]
+
+        # WaitingForIndirectPing timeouts -> removal (kaboodle.rs:617-627),
+        # judged on the same pre-tick snapshot (an entry escalated this tick is
+        # not removed this tick).
+        rem = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (age0 >= cfg.ping_timeout_ticks)
+        jstar_cell = idx[None, :] == jstar[:, None]
+        rem |= insta_remove[:, None] & jstar_cell
+        S = jnp.where(rem, jnp.int8(0), S)
+        # The accompanying Failed broadcasts are inert in the reference (quirk
+        # Q3) — modeled only in intended-semantics mode below.
+        esc_cell = escalate[:, None] & jstar_cell
+        S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
+        T = jnp.where(esc_cell, t, T)
+
+        # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
+        elig = alive[:, None] & (S == KNOWN) & ~eye
+        ping_tgt = choose_one_of_oldest_k(T, elig, cfg.num_candidate_target_peers, key_ping, det)
+        has_ping = ping_tgt >= 0
+        tgt_cell = has_ping[:, None] & (idx[None, :] == ping_tgt[:, None])
+        S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
+        T = jnp.where(tgt_cell, t, T)
+
+        # A4: manual pings (ping_addrs, kaboodle.rs:550-556): no state change at
+        # the sender. Self-pings are dropped at the transport (deviation D8,
+        # matching LockstepMesh._deliver_round).
+        man_tgt = jnp.where(alive & (inp.manual_target != idx), inp.manual_target, -1)
+
+        member_a = S > 0
+        row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
+
+        # ================= B. Broadcast delivery (kaboodle.rs:256-311) ========
+        # Join o accepted at r: Jm[r, o]. Receivers insert the joiner as
+        # Known(now), preserving nothing else (kaboodle.rs:284-304).
+        Jm = join_b[None, :] & ok.T & ~eye  # [receiver, origin]
+        is_new_ro = Jm & ~member_a
+        S = jnp.where(Jm, jnp.int8(KNOWN), S)
+        T = jnp.where(Jm, t, T)
+
+        if not cfg.faithful_failed_broadcast:
+            # Failed(j) broadcast by i, delivered to r (r != j): remove j.
+            # Broadcasts resolve in origin order (the lockstep contract), so a
+            # same-tick Join(j) wins only against Failed origins i < j; any
+            # delivering Failed origin i > j removes j after the re-insert.
+            # (When Join(j) was not delivered at r, any Failed origin removes.)
+            rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
+            fail_gt = _bool_matmul(ok.T, rem_gt)  # [r, j]
+            fail_any = _bool_matmul(ok.T, rem)  # [r, j]
+            fail_del = ~eye & jnp.where(Jm, fail_gt, fail_any)
+            S = jnp.where(fail_del, jnp.int8(0), S)
+
+        # Join responses (kaboodle.rs:333-392): r replies to each *new* joiner
+        # with probability max(1, 100-n^2)% where n tracks the sequentially
+        # growing map (cumulative inserts in origin order — exact parity).
+        n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
+        reply_p = broadcast_reply_prob(n_after)
+        bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
+        reply = is_new_ro & bern  # [r, o]
+        reply_del = reply & ok  # response unicast r -> o gated like any message
+
+        # Gossip union at joiner o (deliverable in call 2): the reply share is
+        # r's map at reply time = start-of-round map + joiners accepted with
+        # origin index <= o (the oracle's sequential processing order):
+        #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
+        # Two boolean matmuls; skipped entirely on join-free ticks.
+        share_base = member_a
+        if cfg.max_share_peers and n > cfg.max_share_peers:
+            # D5: cap to lowest-index members of the start-of-round map.
+            within_cap = jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+            share_base = member_a & within_cap
+
+        def _gossip(_):
+            term1 = _bool_matmul(reply_del.T, share_base)  # [o, j]
+            term2 = _bool_matmul(reply_del.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
+            tri = idx[None, :] <= idx[:, None]  # j <= o
+            return term1 | (term2 & tri)
+
+        gossip = jax.lax.cond(
+            jnp.any(join_b),
+            _gossip,
+            lambda _: jnp.zeros((n, n), dtype=bool),
+            operand=None,
+        )
+
+        # ================= Call 1: Pings + PingRequests =======================
+        ok_ping = has_ping & _gather_edge(ok, idx, ping_tgt)
+        ok_man = (man_tgt >= 0) & _gather_edge(ok, idx, man_tgt)
+        del_pr = proxies_valid & _gather_edge(ok, idx[:, None], proxies)  # [N, k]
+
+        mark1 = jnp.zeros((n, n), dtype=bool)  # mark1[dest, sender]
+        mark1 = _scatter_or(mark1, ping_tgt, idx, ok_ping)
+        mark1 = _scatter_or(mark1, man_tgt, idx, ok_man)
+        mark1 = _scatter_or(mark1, proxies, idx[:, None], del_pr)
+        S = jnp.where(mark1, jnp.int8(KNOWN), S)
+        T = jnp.where(mark1, t, T)
+
+        member_1 = S > 0
+        fp1, n1 = _fingerprint_and_count(member_1, rec_hash)
+
+        # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
+        # proxies' Pings to the suspect (kaboodle.rs:533-545).
+        del_ack = ok_ping & _gather_edge(ok, ping_tgt, idx)  # tgt -> pinger
+        del_ack_man = ok_man & _gather_edge(ok, man_tgt, idx)
+        ok_p2x = _gather_edge(ok, proxies, jstar[:, None])  # proxy -> suspect
+        del_pping = del_pr & ok_p2x  # [N, k]
+
+        # ================= Call 2: Acks, proxy Pings, join responses ==========
+        mark2 = jnp.zeros((n, n), dtype=bool)
+        mark2 = _scatter_or(mark2, idx, ping_tgt, del_ack)  # pinger marks target
+        mark2 = _scatter_or(mark2, idx, man_tgt, del_ack_man)
+        mark2 = _scatter_or(
+            mark2, jnp.broadcast_to(jstar[:, None], proxies.shape), proxies, del_pping
+        )  # suspect marks proxy
+        mark2 |= reply_del.T  # joiner marks join-responder
+        S = jnp.where(mark2, jnp.int8(KNOWN), S)
+        T = jnp.where(mark2, t, T)
+
+        # Gossip-learned peers insert back-dated (Q6) where still unknown.
+        gossip_new = gossip & ~(S > 0)
+        S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
+        T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
+
+        member_2 = S > 0
+        fp2, n2 = _fingerprint_and_count(member_2, rec_hash)
+
+        # Queued: the suspect's Acks back to the proxies.
+        del_pack = del_pping & _gather_edge(ok, jstar[:, None], proxies)  # [N, k]
+
+        # Coincidence forwarding (kaboodle.rs:418-443 pop semantics): if proxy
+        # p's own direct or manual ping this tick targeted the same suspect,
+        # p's call-2 Ack for it pops the curious entry and forwards fp1-payload
+        # Acks in call 3; the call-3 proxy Ack then finds curious empty.
+        p_tgt = ping_tgt[jnp.clip(proxies, 0)]  # [N, k] the proxies' own ping targets
+        p_man = man_tgt[jnp.clip(proxies, 0)]
+        p_got_direct = del_ack[jnp.clip(proxies, 0)]
+        p_got_man = del_ack_man[jnp.clip(proxies, 0)]
+        pop_hit = ((p_tgt == jstar[:, None]) & p_got_direct) | (
+            (p_man == jstar[:, None]) & p_got_man
+        )
+        fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
+        del_fwd_c = fwd_c & _gather_edge(ok, proxies, idx[:, None])  # p -> suspector
+
+        # ================= Call 3: suspect Acks at proxies ====================
+        mark3 = jnp.zeros((n, n), dtype=bool)
+        mark3 = _scatter_or(
+            mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
+        )  # proxy marks suspect — the proxy's own view resurrects (Q1)
+        mark3 = _scatter_or(mark3, idx[:, None], proxies, del_fwd_c)  # suspector marks pinger-proxy
+        S = jnp.where(mark3, jnp.int8(KNOWN), S)
+        T = jnp.where(mark3, t, T)
+
+        # Proxy forwards the suspect's Ack (fp2 payload) unless the curious
+        # entry was already popped by the call-2 coincidence.
+        fwd = del_pack & ~pop_hit
+        del_fwd = fwd & _gather_edge(ok, proxies, idx[:, None])  # [N, k] p -> suspector
+
+        # ================= Call 4: forwarded Acks =============================
+        # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is the
+        # proxy, so the suspector marks the proxy — the suspect stays
+        # WaitingForIndirectPing (kaboodle.rs:408-415 applies to the sender).
+        mark4 = jnp.zeros((n, n), dtype=bool)
+        mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
+        S = jnp.where(mark4, jnp.int8(KNOWN), S)
+        T = jnp.where(mark4, t, T)
+        if not cfg.faithful_indirect_ack:
+            # Intended-SWIM mode: a forwarded ack clears the suspect too.
+            cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
+            clr_cell = cleared[:, None] & jstar_cell & (S > 0)
+            S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
+            T = jnp.where(clr_cell, t, T)
+
+        # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
+        member_g = S > 0
+        fp_g, n_g = _fingerprint_and_count(member_g, rec_hash)
+
+        # Candidate priority = phase_base + sender index; first match wins
+        # (take_sync_request scans in arrival order). Match condition:
+        # their_fp != our_fp and our_n <= their_n (kaboodle.rs:717-726).
+        INF = jnp.int32(_I32MAX)
+
+        # Phase 0: last tick's KnownPeersRequest senders (first in the list —
+        # their candidates were recorded before this tick's acks arrived).
+        m0 = (st.kpr_partner[None, :] == idx[:, None]) & alive[:, None] & ~rv[:, None]
+        match0 = m0 & (st.kpr_fp[None, :] != fp_g[:, None]) & (n_g[:, None] <= st.kpr_n[None, :])
+        prio0 = jnp.min(jnp.where(match0, idx[None, :], INF), axis=-1)
+        peer0 = prio0  # sender == candidate peer for KPR candidates
+
+        # Phase 1 (call-2 acks): direct + manual, sender == acked peer.
+        base1 = jnp.int32(n)
+        m_d = del_ack & (fp1[jnp.clip(ping_tgt, 0)] != fp_g) & (n_g <= n1[jnp.clip(ping_tgt, 0)])
+        m_m = del_ack_man & (fp1[jnp.clip(man_tgt, 0)] != fp_g) & (n_g <= n1[jnp.clip(man_tgt, 0)])
+        prio_d = jnp.where(m_d, base1 + ping_tgt, INF)
+        prio_m = jnp.where(m_m, base1 + man_tgt, INF)
+        prio1 = jnp.minimum(prio_d, prio_m)
+        peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
+
+        # Phase 2 (call-3 acks): suspect acks at proxies (sender = suspect)
+        # and coincidence forwards at suspectors (sender = pinger-proxy).
+        base2 = jnp.int32(2 * n)
+        x_fp2 = fp2[jnp.clip(jstar, 0)]  # [N] suspect's fp2 per suspector row
+        x_n2 = n2[jnp.clip(jstar, 0)]
+        # at proxy P: candidate (X, fp2[X], n2[X]) — scatter-min over edges.
+        m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
+            n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
+        )
+        prio_proxy = jnp.full((n,), INF).at[jnp.clip(proxies, 0)].min(
+            jnp.where(m_px, base2 + jstar[:, None], INF)
+        )
+        peer_proxy = prio_proxy - base2  # sender == X == candidate peer
+        # at suspector s: candidate (X, fp1[X], n1[X]) via coincidence forward.
+        x_fp1 = fp1[jnp.clip(jstar, 0)]
+        x_n1 = n1[jnp.clip(jstar, 0)]
+        m_cf = del_fwd_c & (x_fp1[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n1[:, None])
+        prio_coinc = jnp.min(jnp.where(m_cf, base2 + proxies, INF), axis=-1)
+        prio2 = jnp.minimum(prio_proxy, prio_coinc)
+        peer2 = jnp.where(prio_proxy <= prio_coinc, peer_proxy, jstar)
+
+        # Phase 3 (call-4 forwarded acks): candidate (X, fp2[X], n2[X]),
+        # sender = forwarding proxy.
+        base3 = jnp.int32(3 * n)
+        m_f = del_fwd & (x_fp2[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n2[:, None])
+        prio3 = jnp.min(jnp.where(m_f, base3 + proxies, INF), axis=-1)
+        peer3 = jstar
+
+        best = jnp.minimum(jnp.minimum(prio0, prio1), jnp.minimum(prio2, prio3))
+        partner = jnp.where(
+            best == prio0,
+            peer0,
+            jnp.where(best == prio1, peer1, jnp.where(best == prio2, peer2, peer3)),
+        ).astype(jnp.int32)
+        has_req = (best != INF) & alive
+        partner = jnp.where(has_req, partner, -1)
+
+        # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
+        del_kpr = has_req & _gather_edge(ok, idx, partner)
+        mark_g = jnp.zeros((n, n), dtype=bool)
+        mark_g = _scatter_or(mark_g, partner, idx, del_kpr)  # partner marks requester
+        S = jnp.where(mark_g, jnp.int8(KNOWN), S)
+        T = jnp.where(mark_g, t, T)
+
+        # Filtered reply share (kaboodle.rs:483-501): Known peers heard from
+        # strictly within MAX_PEER_SHARE_AGE, excluding self (and the
+        # requester — enforced receiver-side as j != i, same effect). Computed
+        # post-marks, matching the oracle's two-pass delivery. Not capped (Q12).
+        share_f = (S == KNOWN) & ~eye & ((t - T) < cfg.max_peer_share_age_ticks)
+        del_rep = del_kpr & _gather_edge(ok, partner, idx)  # partner -> requester
+        mark_rep = jnp.zeros((n, n), dtype=bool)
+        mark_rep = _scatter_or(mark_rep, idx, partner, del_rep)  # requester marks partner
+        S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
+        T = jnp.where(mark_rep, t, T)
+        srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
+        rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
+        S = jnp.where(rep_ins, jnp.int8(KNOWN), S)
+        T = jnp.where(rep_ins, t - cfg.max_peer_share_age_ticks, T)
+
+        # ================= metrics + next state ===============================
+        member_f = S > 0
+        fp_f, n_f = _fingerprint_and_count(member_f, rec_hash)
+        fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
+        fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
+        n_alive = jnp.sum(alive, dtype=jnp.int32)
+        converged = (fpa_min == fpa_max) & (n_alive > 0)
+        agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
+
+        msgs = (
+            jnp.sum(ok_ping, dtype=jnp.int32)
+            + jnp.sum(ok_man, dtype=jnp.int32)
+            + jnp.sum(del_pr, dtype=jnp.int32)
+            + jnp.sum(del_ack, dtype=jnp.int32)
+            + jnp.sum(del_ack_man, dtype=jnp.int32)
+            + jnp.sum(del_pping, dtype=jnp.int32)
+            + jnp.sum(reply_del, dtype=jnp.int32)
+            + jnp.sum(del_pack, dtype=jnp.int32)
+            + jnp.sum(del_fwd_c, dtype=jnp.int32)
+            + jnp.sum(del_fwd, dtype=jnp.int32)
+            + jnp.sum(del_kpr, dtype=jnp.int32)
+            + jnp.sum(del_rep, dtype=jnp.int32)
+        )
+
+        new_state = MeshState(
+            state=S,
+            timer=T,
+            alive=alive,
+            identity=st.identity,
+            never_broadcast=never_b,
+            last_broadcast=last_b,
+            kpr_partner=jnp.where(del_kpr, partner, -1),
+            kpr_fp=fp_g,
+            kpr_n=n_g,
+            tick=t + 1,
+            key=key_next,
+        )
+        metrics = TickMetrics(
+            messages_delivered=msgs,
+            converged=converged,
+            agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
+            mean_membership=jnp.sum(jnp.where(alive, n_f, 0)).astype(jnp.float32)
+            / jnp.maximum(n_alive, 1),
+            fingerprint_min=fpa_min,
+            fingerprint_max=fpa_max,
+        )
+        return new_state, metrics
+
+    return tick

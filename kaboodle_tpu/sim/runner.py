@@ -1,0 +1,66 @@
+"""Rolling the tick kernel: fixed-length scans and convergence-bounded runs.
+
+The reference's ``run()`` loop (kaboodle.rs:781-786) ticks until cancelled;
+the simulator's equivalents are:
+
+- :func:`simulate` — ``lax.scan`` over a stacked ``TickInputs`` pytree,
+  returning the final state plus per-tick metrics (the structured-metrics
+  subsystem SURVEY.md §5 calls for).
+- :func:`run_until_converged` — ``lax.while_loop`` that stops as soon as all
+  alive peers agree on the mesh fingerprint (the reference's convergence
+  signal, README.md:19-29), up to ``max_ticks``. Fault-free dynamics only
+  (while_loop carries no per-tick inputs); used by the benchmark driver.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, idle_inputs
+
+
+def simulate(
+    state: MeshState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    faulty: bool = True,
+) -> tuple[MeshState, TickMetrics]:
+    """Scan the tick kernel over ``inputs`` stacked along a leading [T] axis."""
+    tick = make_tick_fn(cfg, faulty=faulty)
+    return jax.lax.scan(tick, state, inputs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_ticks"))
+def run_until_converged(
+    state: MeshState,
+    cfg: SwimConfig,
+    max_ticks: int = 64,
+) -> tuple[MeshState, jax.Array, jax.Array]:
+    """Tick the fault-free kernel until fingerprint agreement or ``max_ticks``.
+
+    Returns ``(final_state, ticks_run, converged)``. ``ticks_run`` counts the
+    ticks actually executed; convergence is evaluated on end-of-tick state,
+    matching ``LockstepMesh.converged()``.
+    """
+    n = state.n
+    tick = make_tick_fn(cfg, faulty=False)
+    idle = idle_inputs(n)
+
+    def cond(carry):
+        st, i, conv = carry
+        return (~conv) & (i < max_ticks)
+
+    def body(carry):
+        st, i, _ = carry
+        st, m = tick(st, idle)
+        return st, i + 1, m.converged
+
+    final, ticks, conv = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.bool_(False))
+    )
+    return final, ticks, conv

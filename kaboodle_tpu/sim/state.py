@@ -1,0 +1,131 @@
+"""Mesh state, per-tick inputs, and per-tick metrics for the tick kernel.
+
+The whole mesh — N simulated SWIM peers — is a handful of dense tensors. Row i
+is peer i's private view of the mesh, the tensor analogue of the reference's
+per-process ``Arc<Mutex<KnownPeers>>`` (lib.rs:66, structs.rs:14):
+
+- ``state[i, j]``  what peer i believes about peer j (spec codes: NOT_MEMBER /
+  KNOWN / WAITING_FOR_PING / WAITING_FOR_INDIRECT_PING — structs.rs:27-41).
+- ``timer[i, j]``  the tick stamp stored inside the reference's ``PeerState``
+  variants (``Instant``): last-heard for Known, sent-at for the waiting states.
+
+Everything else is O(N): aliveness, identity words, join-broadcast throttling
+state (kaboodle.rs:102-103), and the carried-over anti-entropy candidate from
+the previous tick's KnownPeersRequest deliveries (kaboodle.rs:707-740).
+
+All fields are plain arrays so the pytree shards trivially: the row axis (axis
+0 of the ``[N, N]`` tensors, the only axis of the ``[N]`` vectors) is the data-
+parallel axis that `kaboodle_tpu.parallel` distributes across chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.spec import KNOWN
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MeshState:
+    """Complete simulator state for N peers. See module docstring."""
+
+    state: jax.Array  # int8  [N, N] spec state codes
+    timer: jax.Array  # int32 [N, N] tick stamps
+    alive: jax.Array  # bool  [N]    silent-leave churn (quirk Q8)
+    identity: jax.Array  # uint32 [N] identity word per peer (lib.rs:88-92)
+    never_broadcast: jax.Array  # bool [N]  true until the first Join broadcast
+    last_broadcast: jax.Array  # int32 [N] tick of last Join (kaboodle.rs:102)
+    # The previous tick's anti-entropy request, stored at the *sender*:
+    # peer s sent KnownPeersRequest{kpr_fp[s], kpr_n[s]} to kpr_partner[s]
+    # (-1: none / dropped). Receivers turn these into this tick's first-priority
+    # sync candidates (kaboodle.rs:448-512 records them; resolution is D2).
+    kpr_partner: jax.Array  # int32 [N]
+    kpr_fp: jax.Array  # uint32 [N]
+    kpr_n: jax.Array  # int32 [N]
+    tick: jax.Array  # int32 scalar
+    key: jax.Array  # PRNG key (counter-based; the ChaChaRng analogue, kaboodle.rs:164)
+
+    @property
+    def n(self) -> int:
+        return self.state.shape[-1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TickInputs:
+    """Per-tick scenario inputs. Stack along a leading [T] axis to scan.
+
+    ``drop_ok[s, d]`` gates delivery of every unicast and broadcast from s to d
+    this tick (the simulator's fault-injection surface; the reference has no
+    equivalent — SURVEY.md §5). ``partition[s]`` is a group id; messages cross
+    groups only if the ids match. ``manual_target`` injects one manual ping per
+    peer (the `ping_addrs` API, lib.rs:268-297), -1 for none.
+    """
+
+    kill: jax.Array  # bool [N] silent leave this tick (Q8)
+    revive: jax.Array  # bool [N] reset + rejoin this tick
+    partition: jax.Array  # int32 [N] partition group ids (all equal = no partition)
+    drop_rate: jax.Array  # float32 [] random per-edge drop probability
+    manual_target: jax.Array  # int32 [N] manual ping target or -1
+    drop_ok: jax.Array | None = None  # bool [N, N] explicit delivery gate (tests)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TickMetrics:
+    """Per-tick observability — free tensor reductions (SURVEY.md §5)."""
+
+    messages_delivered: jax.Array  # int32 [] unicasts delivered this tick
+    converged: jax.Array  # bool [] all alive peers agree on the fingerprint
+    agree_fraction: jax.Array  # float32 [] fraction of alive peers at the min fingerprint
+    mean_membership: jax.Array  # float32 [] mean map size over alive peers
+    fingerprint_min: jax.Array  # uint32 []
+    fingerprint_max: jax.Array  # uint32 []
+
+
+def init_state(
+    n: int,
+    identities: jax.Array | None = None,
+    seed: int = 0,
+    alive: jax.Array | None = None,
+) -> MeshState:
+    """Fresh mesh: every peer knows only itself (kaboodle.rs:144-152) and will
+    broadcast Join on its first active phase (kaboodle.rs:228-251)."""
+    idx = jnp.arange(n, dtype=jnp.int32)
+    eye = idx[:, None] == idx[None, :]
+    if identities is None:
+        # LockstepMesh's default: identity word = index + 1.
+        identities = (idx + 1).astype(jnp.uint32)
+    return MeshState(
+        state=jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)),
+        timer=jnp.zeros((n, n), dtype=jnp.int32),
+        alive=jnp.ones((n,), dtype=bool) if alive is None else alive,
+        identity=jnp.asarray(identities, dtype=jnp.uint32),
+        never_broadcast=jnp.ones((n,), dtype=bool),
+        last_broadcast=jnp.zeros((n,), dtype=jnp.int32),
+        kpr_partner=jnp.full((n,), -1, dtype=jnp.int32),
+        kpr_fp=jnp.zeros((n,), dtype=jnp.uint32),
+        kpr_n=jnp.zeros((n,), dtype=jnp.int32),
+        tick=jnp.int32(0),
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+def idle_inputs(n: int, ticks: int | None = None) -> TickInputs:
+    """No-fault inputs; with ``ticks`` set, stacked [T, ...] for lax.scan."""
+
+    def shp(*s):
+        return (ticks, *s) if ticks is not None else s
+
+    return TickInputs(
+        kill=jnp.zeros(shp(n), dtype=bool),
+        revive=jnp.zeros(shp(n), dtype=bool),
+        partition=jnp.zeros(shp(n), dtype=jnp.int32),
+        drop_rate=jnp.zeros(shp(), dtype=jnp.float32),
+        manual_target=jnp.full(shp(n), -1, dtype=jnp.int32),
+        drop_ok=None,
+    )

@@ -55,3 +55,13 @@ def test_membership_crc32_matches_python_oracle():
         buf = b"".join(recs[j].tobytes() for j in range(n) if member[i, j])
         want.append(zlib.crc32(buf))
     np.testing.assert_array_equal(got, np.array(want, dtype=np.uint32))
+
+
+def test_socket_addr_sort_order():
+    """crc_fingerprint sorts like Rust SocketAddr Ord: numeric IPs, v4 < v6,
+    then port — not lexicographic strings (kaboodle.rs:72-73)."""
+    from kaboodle_tpu.oracle.fingerprint import socket_addr_sort_key
+
+    addrs = ["10.0.0.2:80", "9.0.0.1:80", "[fe80::1]:9", "9.0.0.1:7", "[::1]:80"]
+    ordered = sorted(addrs, key=socket_addr_sort_key)
+    assert ordered == ["9.0.0.1:7", "9.0.0.1:80", "10.0.0.2:80", "[::1]:80", "[fe80::1]:9"]

@@ -1,0 +1,236 @@
+"""Exact trajectory parity: JAX tick kernel vs the lockstep oracle.
+
+In deterministic mode (SwimConfig.deterministic=True) every random draw is
+replaced by a fixed rule both engines implement identically, so the kernel
+must reproduce the oracle's full state — state codes, timers, fingerprints,
+convergence flag, and delivered-message counts — every tick, including under
+churn, message drops, and partitions. This is the simulator's analogue of the
+reference's (absent) test suite: the state machine transition table of
+SURVEY.md §3.2-3.3 pinned as data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.oracle.lockstep import LockstepMesh
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import MeshState, TickInputs, init_state
+
+N = 12
+CFG = SwimConfig(deterministic=True)
+
+
+def _inputs(n, kill=None, revive=None, partition=None, drop_ok=None, manual=None):
+    return TickInputs(
+        kill=jnp.zeros(n, bool) if kill is None else jnp.asarray(kill, bool),
+        revive=jnp.zeros(n, bool) if revive is None else jnp.asarray(revive, bool),
+        partition=jnp.zeros(n, jnp.int32) if partition is None else jnp.asarray(partition, jnp.int32),
+        drop_rate=jnp.float32(0.0),
+        manual_target=jnp.full(n, -1, jnp.int32) if manual is None else jnp.asarray(manual, jnp.int32),
+        drop_ok=jnp.ones((n, n), bool) if drop_ok is None else jnp.asarray(drop_ok, bool),
+    )
+
+
+def _assert_tick_equal(mesh: LockstepMesh, st: MeshState, metrics, tick: int):
+    np.testing.assert_array_equal(
+        np.asarray(st.state), mesh.state_matrix(), err_msg=f"state mismatch at tick {tick}"
+    )
+    # Timers only matter where a state exists.
+    ours = np.asarray(st.timer) * (np.asarray(st.state) > 0)
+    theirs = mesh.timer_matrix() * (mesh.state_matrix() > 0)
+    np.testing.assert_array_equal(ours, theirs, err_msg=f"timer mismatch at tick {tick}")
+    alive = np.asarray(st.alive)
+    fps = np.array(mesh.fingerprints(), dtype=np.uint64) & 0xFFFFFFFF
+    from kaboodle_tpu.ops.hashing import membership_fingerprint
+
+    kfp = np.asarray(membership_fingerprint(st.state > 0, st.identity), dtype=np.uint64)
+    np.testing.assert_array_equal(
+        kfp[alive], fps[alive], err_msg=f"fingerprint mismatch at tick {tick}"
+    )
+    assert bool(metrics.converged) == mesh.converged(), f"convergence flag at tick {tick}"
+    assert int(metrics.messages_delivered) == mesh.last_tick_messages, (
+        f"message count at tick {tick}: kernel {int(metrics.messages_delivered)} "
+        f"vs oracle {mesh.last_tick_messages}"
+    )
+
+
+def _run_parity(mesh: LockstepMesh, st: MeshState, inputs_per_tick):
+    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
+    for i, inp in enumerate(inputs_per_tick):
+        kill = np.asarray(inp.kill)
+        revive = np.asarray(inp.revive)
+        for p in np.nonzero(kill)[0]:
+            mesh.kill(int(p))
+        for p in np.nonzero(revive)[0]:
+            mesh.revive(int(p))
+        dok = np.asarray(inp.drop_ok)
+        part = np.asarray(inp.partition)
+        mesh.delivery_ok = lambda s, r, t, dok=dok, part=part: bool(
+            dok[s, r] and part[s] == part[r]
+        )
+        mesh.tick()
+        st, metrics = tick_fn(st, inp)
+        _assert_tick_equal(mesh, st, metrics, i)
+    return st
+
+
+def test_fresh_boot_parity():
+    """Boot N peers knowing only themselves; converge via Join broadcasts +
+    anti-entropy (BASELINE config 2 dynamics)."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    _run_parity(mesh, st, [_inputs(N) for _ in range(12)])
+
+
+def test_churn_parity():
+    """Silent kills exercise the WaitingForPing -> indirect-ping -> removal
+    path (kaboodle.rs:558-653); a revive exercises re-join."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    plan = []
+    for i in range(20):
+        kill = np.zeros(N, bool)
+        revive = np.zeros(N, bool)
+        if i == 4:
+            kill[2] = True
+            kill[7] = True
+        if i == 14:
+            revive[2] = True
+        plan.append(_inputs(N, kill=kill, revive=revive))
+    _run_parity(mesh, st, plan)
+
+
+def test_drop_mask_parity():
+    """Random (but fixed, shared) delivery-drop masks each tick."""
+    rng = np.random.default_rng(42)
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    plan = [_inputs(N, drop_ok=rng.random((N, N)) > 0.25) for _ in range(15)]
+    _run_parity(mesh, st, plan)
+
+
+def test_partition_heal_parity():
+    """Split-brain then heal (BASELINE config 5 dynamics): two groups converge
+    independently, then re-merge after the partition lifts."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    part = np.zeros(N, np.int32)
+    part[N // 2 :] = 1
+    plan = []
+    for i in range(24):
+        kill = np.zeros(N, bool)
+        if i == 6:
+            kill[1] = True  # churn inside a partition
+        plan.append(_inputs(N, partition=part if 2 <= i < 12 else None, kill=kill))
+    _run_parity(mesh, st, plan)
+
+
+def test_manual_ping_parity():
+    """ping_addrs (lib.rs:268-297): manual pings mark + ack without state
+    transitions at the sender."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    plan = []
+    for i in range(8):
+        manual = np.full(N, -1, np.int64)
+        if i == 2:
+            manual[0] = 5
+            manual[3] = 0
+        plan.append(_inputs(N, manual=manual))
+
+    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
+    for i, inp in enumerate(plan):
+        manual = np.asarray(inp.manual_target)
+        for p in np.nonzero(manual >= 0)[0]:
+            mesh.engines[p].pending_manual_pings.append(int(manual[p]))
+        mesh.tick()
+        st, metrics = tick_fn(st, inp)
+        _assert_tick_equal(mesh, st, metrics, i)
+
+
+def test_kernel_determinism():
+    """Same seed => bitwise-identical trajectory (SURVEY.md §5: the pure-
+    functional kernel's answer to race detection)."""
+    tick_fn = jax.jit(make_tick_fn(SwimConfig(), faulty=False))
+    outs = []
+    for _ in range(2):
+        st = init_state(N, seed=7)
+        inp = _inputs(N)
+        inp = TickInputs(
+            kill=inp.kill, revive=inp.revive, partition=inp.partition,
+            drop_rate=inp.drop_rate, manual_target=inp.manual_target, drop_ok=None,
+        )
+        for _ in range(6):
+            st, _m = tick_fn(st, inp)
+        outs.append((np.asarray(st.state), np.asarray(st.timer)))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+
+
+def test_random_mode_converges():
+    """Random mode (jax.random draws): boot converges and stays converged."""
+    tick_fn = jax.jit(make_tick_fn(SwimConfig(), faulty=False))
+    st = init_state(32, seed=3)
+    inp = TickInputs(
+        kill=jnp.zeros(32, bool), revive=jnp.zeros(32, bool),
+        partition=jnp.zeros(32, jnp.int32), drop_rate=jnp.float32(0),
+        manual_target=jnp.full(32, -1, jnp.int32), drop_ok=None,
+    )
+    converged_at = None
+    for i in range(12):
+        st, m = tick_fn(st, inp)
+        if bool(m.converged) and converged_at is None:
+            converged_at = i
+    assert converged_at is not None and converged_at <= 3
+    assert bool(m.converged)
+
+
+def test_intended_failed_broadcast_parity():
+    """faithful_failed_broadcast=False (intended SWIM semantics): Failed
+    broadcasts actually remove peers, so removal propagates mesh-wide the
+    tick the first suspector gives up — including the Join-vs-Failed
+    same-tick ordering race (broadcasts resolve in origin order)."""
+    cfg = SwimConfig(deterministic=True, faithful_failed_broadcast=False)
+    mesh = LockstepMesh(N, cfg)
+    st = init_state(N)
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
+    plan = []
+    for i in range(22):
+        kill = np.zeros(N, bool)
+        revive = np.zeros(N, bool)
+        if i == 3:
+            kill[5] = True
+        if i == 9:
+            revive[5] = True  # likely to collide with a straggler's Failed(5)
+        plan.append(_inputs(N, kill=kill, revive=revive))
+    for i, inp in enumerate(plan):
+        for p in np.nonzero(np.asarray(inp.kill))[0]:
+            mesh.kill(int(p))
+        for p in np.nonzero(np.asarray(inp.revive))[0]:
+            mesh.revive(int(p))
+        mesh.tick()
+        st, metrics = tick_fn(st, inp)
+        _assert_tick_equal(mesh, st, metrics, i)
+
+
+def test_manual_self_ping_dropped():
+    """D8: manual self-pings are dropped at the transport in both engines."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
+    manual = np.full(N, -1, np.int64)
+    manual[4] = 4  # self-ping: must be a no-op
+    plan = [_inputs(N, manual=manual if i == 1 else None) for i in range(4)]
+    for i, inp in enumerate(plan):
+        man = np.asarray(inp.manual_target)
+        for p in np.nonzero(man >= 0)[0]:
+            mesh.engines[p].pending_manual_pings.append(int(man[p]))
+        mesh.tick()
+        st, metrics = tick_fn(st, inp)
+        _assert_tick_equal(mesh, st, metrics, i)

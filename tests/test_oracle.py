@@ -260,7 +260,7 @@ def test_pingrequest_relays_and_records_curious():
     # target acks -> forward to requester
     out = e.on_unicast(7, 107, Ack(7, 42, 3), now=1)
     fwd = [(d, m) for d, m in out.unicasts if isinstance(m, Ack)]
-    assert fwd == [(0, Ack(7, 42, 3))]
+    assert fwd == [(0, Ack(7, 42, 3, forwarded=True))]  # D7: relays are tagged
     assert 7 not in e.curious
 
 

@@ -1,0 +1,25 @@
+"""Device-mesh sharding for the simulator (the framework's scale-out layer)."""
+
+from kaboodle_tpu.parallel.mesh import (
+    PEER_AXIS,
+    inputs_specs,
+    make_mesh,
+    make_sharded_tick,
+    run_until_converged_sharded,
+    shard_inputs,
+    shard_state,
+    simulate_sharded,
+    state_specs,
+)
+
+__all__ = [
+    "PEER_AXIS",
+    "inputs_specs",
+    "make_mesh",
+    "make_sharded_tick",
+    "run_until_converged_sharded",
+    "shard_inputs",
+    "shard_state",
+    "simulate_sharded",
+    "state_specs",
+]

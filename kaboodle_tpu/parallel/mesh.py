@@ -1,0 +1,151 @@
+"""Sharding layer: the N-peer axis distributed across a TPU device mesh.
+
+The reference scales only by adding OS processes — one per peer, four panes in
+the zellij demo (justfile:10-15, 2x2-layout.kdl). The simulator's scale axis is
+the same N, but as the leading axis of the ``[N, N]`` state tensors; this
+module shards that axis across chips (SURVEY.md §5 "long-context" slot: peer
+sharding is this project's context parallelism).
+
+Design: **GSPMD, not hand-rolled collectives.** The tick kernel
+(kaboodle_tpu.sim.kernel) is written as global-view array ops; here we
+
+- place every row-indexed tensor with ``NamedSharding(mesh, P('peers', ...))``,
+- re-pin the carry's sharding each tick with ``with_sharding_constraint`` so
+  the layout is stable under ``lax.scan``,
+
+and let XLA's SPMD partitioner insert the ICI collectives: the join-gossip
+boolean matmuls become all-gathers + local matmuls, the row broadcasts of
+``rec_hash``/fingerprints become all-gathers, cross-shard scatter-marks become
+all-to-alls, and the convergence min/max reduction becomes an all-reduce —
+exactly the mapping SURVEY.md §2.3 calls for, without a line of manual
+``psum``. Scaling beyond one slice rides the same specs over DCN.
+
+A mesh here is ``Mesh(devices, ('peers',))``; the model-parallel axis is
+deliberately absent (there is no hidden dimension to shard — membership rows
+are the only big axis).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import converge_loop
+from kaboodle_tpu.sim.state import MeshState, TickInputs
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D device mesh over the peer axis (all local devices by default)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"asked for {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PEER_AXIS,))
+
+
+def state_specs() -> MeshState:
+    """PartitionSpecs for MeshState: row axis sharded, control scalars replicated."""
+    row2 = P(PEER_AXIS, None)
+    row1 = P(PEER_AXIS)
+    rep = P()
+    return MeshState(
+        state=row2,
+        timer=row2,
+        alive=row1,
+        identity=row1,
+        never_broadcast=row1,
+        last_broadcast=row1,
+        kpr_partner=row1,
+        kpr_fp=row1,
+        kpr_n=row1,
+        tick=rep,
+        key=rep,
+    )
+
+
+def inputs_specs(stacked: bool = False, with_drop_ok: bool = False) -> TickInputs:
+    """PartitionSpecs for TickInputs; ``stacked`` adds the leading scan [T] axis."""
+    lead = (None,) if stacked else ()
+    row1 = P(*lead, PEER_AXIS)
+    row2 = P(*lead, PEER_AXIS, None)
+    rep = P(*lead) if stacked else P()
+    return TickInputs(
+        kill=row1,
+        revive=row1,
+        partition=row1,
+        drop_rate=rep,
+        manual_target=row1,
+        drop_ok=row2 if with_drop_ok else None,
+    )
+
+
+def _named(mesh: Mesh, specs):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_state(state: MeshState, mesh: Mesh) -> MeshState:
+    """Place a MeshState on the mesh (row axis split across ``peers``)."""
+    if state.state.shape[0] % mesh.size != 0:
+        raise ValueError(f"N={state.state.shape[0]} not divisible by mesh size {mesh.size}")
+    return jax.device_put(state, _named(mesh, state_specs()))
+
+
+def shard_inputs(inputs: TickInputs, mesh: Mesh, stacked: bool = False) -> TickInputs:
+    """Place TickInputs on the mesh; pass ``stacked=True`` for scan-stacked [T, ...]."""
+    specs = inputs_specs(stacked=stacked, with_drop_ok=inputs.drop_ok is not None)
+    return jax.device_put(inputs, _named(mesh, specs))
+
+
+def make_sharded_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
+    """Tick fn whose output carry is constrained back onto the mesh layout.
+
+    The constraint after every tick keeps the scan carry's sharding fixed, so
+    XLA partitions each tick identically instead of re-deciding layouts."""
+    tick = make_tick_fn(cfg, faulty=faulty)
+    shardings = _named(mesh, state_specs())
+
+    def sharded_tick(st: MeshState, inp: TickInputs):
+        st, m = tick(st, inp)
+        st = jax.tree.map(jax.lax.with_sharding_constraint, st, shardings)
+        return st, m
+
+    return sharded_tick
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "faulty"))
+def simulate_sharded(
+    state: MeshState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    mesh: Mesh,
+    faulty: bool = True,
+):
+    """Sharded twin of :func:`kaboodle_tpu.sim.runner.simulate` (lax.scan)."""
+    tick = make_sharded_tick(cfg, mesh, faulty=faulty)
+    return jax.lax.scan(tick, state, inputs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_ticks"))
+def run_until_converged_sharded(
+    state: MeshState,
+    cfg: SwimConfig,
+    mesh: Mesh,
+    max_ticks: int = 64,
+):
+    """Sharded twin of :func:`kaboodle_tpu.sim.runner.run_until_converged`.
+
+    The convergence test (fingerprint min == max over alive peers) partitions
+    into a per-shard reduction + ICI all-reduce — the BASELINE.json config-4
+    "ICI all-reduce fingerprint check"."""
+    return converge_loop(state, make_sharded_tick(cfg, mesh, faulty=False), max_ticks)

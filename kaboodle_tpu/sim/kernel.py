@@ -43,9 +43,10 @@ Memory/layout notes (TPU):
 - ``state`` int8 and ``timer`` int32 are the only [N, N] residents; every
   message "queue" is O(N) or O(N·k) (the per-tick fan-outs are bounded by the
   protocol: 1 ping, k=3 ping-reqs, 1 anti-entropy request per peer).
-- The only O(N^3) work is the join-response gossip union, expressed as two
-  int8 matmuls (MXU-friendly) and skipped via ``lax.cond`` on ticks with no
-  Join broadcast.
+- The only O(N^3) work is the join-response gossip union (and, in
+  intended-semantics mode, the Failed-broadcast delivery), expressed as int8
+  matmuls (MXU-friendly) and skipped via ``lax.cond`` on ticks with no Join
+  broadcast (resp. no removal).
 - Everything is static-shaped; the whole tick jits into one XLA program and
   rolls under ``lax.scan`` (runner.py).
 """
@@ -208,9 +209,15 @@ def make_tick_fn(
         T = jnp.where(tgt_cell, t, T)
 
         # A4: manual pings (ping_addrs, kaboodle.rs:550-556): no state change at
-        # the sender. Self-pings are dropped at the transport (deviation D8,
-        # matching LockstepMesh._deliver_round).
-        man_tgt = jnp.where(alive & (inp.manual_target != idx), inp.manual_target, -1)
+        # the sender. Self-pings and out-of-range targets are dropped at the
+        # transport (deviation D8, matching LockstepMesh._deliver_round's
+        # ``0 <= dest < n`` guard — without this, clamped gathers would fake
+        # an exchange with peer N-1).
+        man_tgt = jnp.where(
+            alive & (inp.manual_target != idx) & (inp.manual_target < n),
+            inp.manual_target,
+            -1,
+        )
 
         member_a = S > 0
         row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
@@ -229,10 +236,20 @@ def make_tick_fn(
             # same-tick Join(j) wins only against Failed origins i < j; any
             # delivering Failed origin i > j removes j after the re-insert.
             # (When Join(j) was not delivered at r, any Failed origin removes.)
-            rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
-            fail_gt = _bool_matmul(ok.T, rem_gt)  # [r, j]
-            fail_any = _bool_matmul(ok.T, rem)  # [r, j]
-            fail_del = ~eye & jnp.where(Jm, fail_gt, fail_any)
+            # O(N^3) matmuls, so skipped on removal-free ticks like the gossip
+            # union below.
+            def _fail_del(_):
+                rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
+                fail_gt = _bool_matmul(ok.T, rem_gt)  # [r, j]
+                fail_any = _bool_matmul(ok.T, rem)  # [r, j]
+                return ~eye & jnp.where(Jm, fail_gt, fail_any)
+
+            fail_del = jax.lax.cond(
+                jnp.any(rem),
+                _fail_del,
+                lambda _: jnp.zeros((n, n), dtype=bool),
+                operand=None,
+            )
             S = jnp.where(fail_del, jnp.int8(0), S)
 
         # Join responses (kaboodle.rs:333-392): r replies to each *new* joiner

@@ -35,21 +35,19 @@ def simulate(
     return jax.lax.scan(tick, state, inputs)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "max_ticks"))
-def run_until_converged(
+def converge_loop(
     state: MeshState,
-    cfg: SwimConfig,
-    max_ticks: int = 64,
+    tick,
+    max_ticks: int,
 ) -> tuple[MeshState, jax.Array, jax.Array]:
-    """Tick the fault-free kernel until fingerprint agreement or ``max_ticks``.
+    """``lax.while_loop`` of ``tick`` until fingerprint agreement or ``max_ticks``.
 
-    Returns ``(final_state, ticks_run, converged)``. ``ticks_run`` counts the
-    ticks actually executed; convergence is evaluated on end-of-tick state,
-    matching ``LockstepMesh.converged()``.
+    The single loop implementation shared by the single-device and sharded
+    entry points (kaboodle_tpu.parallel wraps its mesh-constrained tick around
+    this). Returns ``(final_state, ticks_run, converged)``; convergence is
+    evaluated on end-of-tick state, matching ``LockstepMesh.converged()``.
     """
-    n = state.n
-    tick = make_tick_fn(cfg, faulty=False)
-    idle = idle_inputs(n)
+    idle = idle_inputs(state.n)
 
     def cond(carry):
         st, i, conv = carry
@@ -60,7 +58,14 @@ def run_until_converged(
         st, m = tick(st, idle)
         return st, i + 1, m.converged
 
-    final, ticks, conv = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.bool_(False))
-    )
-    return final, ticks, conv
+    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), jnp.bool_(False)))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_ticks"))
+def run_until_converged(
+    state: MeshState,
+    cfg: SwimConfig,
+    max_ticks: int = 64,
+) -> tuple[MeshState, jax.Array, jax.Array]:
+    """Tick the fault-free kernel until fingerprint agreement or ``max_ticks``."""
+    return converge_loop(state, make_tick_fn(cfg, faulty=False), max_ticks)

@@ -2,13 +2,19 @@
 
 Multi-chip TPU hardware is not available in CI; sharding tests run against
 8 virtual CPU devices (the supported JAX pattern for testing pjit/shard_map
-programs). Must run before the first `import jax` anywhere in the test
-process — pytest imports conftest.py first, so doing it here is sufficient.
+programs). The environment's sitecustomize may have already imported jax and
+registered a TPU plugin with ``jax_platforms`` pinned, so an env-var override
+is not enough — update the config directly (backends are created lazily, so
+this is still before any device materializes).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

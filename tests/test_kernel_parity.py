@@ -60,8 +60,8 @@ def _assert_tick_equal(mesh: LockstepMesh, st: MeshState, metrics, tick: int):
     )
 
 
-def _run_parity(mesh: LockstepMesh, st: MeshState, inputs_per_tick):
-    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
+def _run_parity(mesh: LockstepMesh, st: MeshState, inputs_per_tick, cfg=CFG):
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
     for i, inp in enumerate(inputs_per_tick):
         kill = np.asarray(inp.kill)
         revive = np.asarray(inp.revive)
@@ -69,6 +69,9 @@ def _run_parity(mesh: LockstepMesh, st: MeshState, inputs_per_tick):
             mesh.kill(int(p))
         for p in np.nonzero(revive)[0]:
             mesh.revive(int(p))
+        manual = np.asarray(inp.manual_target)
+        for p in np.nonzero(manual >= 0)[0]:
+            mesh.engines[p].pending_manual_pings.append(int(manual[p]))
         dok = np.asarray(inp.drop_ok)
         part = np.asarray(inp.partition)
         mesh.delivery_ok = lambda s, r, t, dok=dok, part=part: bool(
@@ -143,15 +146,7 @@ def test_manual_ping_parity():
             manual[0] = 5
             manual[3] = 0
         plan.append(_inputs(N, manual=manual))
-
-    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
-    for i, inp in enumerate(plan):
-        manual = np.asarray(inp.manual_target)
-        for p in np.nonzero(manual >= 0)[0]:
-            mesh.engines[p].pending_manual_pings.append(int(manual[p]))
-        mesh.tick()
-        st, metrics = tick_fn(st, inp)
-        _assert_tick_equal(mesh, st, metrics, i)
+    _run_parity(mesh, st, plan)
 
 
 def test_kernel_determinism():
@@ -199,7 +194,6 @@ def test_intended_failed_broadcast_parity():
     cfg = SwimConfig(deterministic=True, faithful_failed_broadcast=False)
     mesh = LockstepMesh(N, cfg)
     st = init_state(N)
-    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
     plan = []
     for i in range(22):
         kill = np.zeros(N, bool)
@@ -209,28 +203,27 @@ def test_intended_failed_broadcast_parity():
         if i == 9:
             revive[5] = True  # likely to collide with a straggler's Failed(5)
         plan.append(_inputs(N, kill=kill, revive=revive))
-    for i, inp in enumerate(plan):
-        for p in np.nonzero(np.asarray(inp.kill))[0]:
-            mesh.kill(int(p))
-        for p in np.nonzero(np.asarray(inp.revive))[0]:
-            mesh.revive(int(p))
-        mesh.tick()
-        st, metrics = tick_fn(st, inp)
-        _assert_tick_equal(mesh, st, metrics, i)
+    _run_parity(mesh, st, plan, cfg=cfg)
 
 
 def test_manual_self_ping_dropped():
     """D8: manual self-pings are dropped at the transport in both engines."""
     mesh = LockstepMesh(N, CFG)
     st = init_state(N)
-    tick_fn = jax.jit(make_tick_fn(CFG, faulty=True))
     manual = np.full(N, -1, np.int64)
     manual[4] = 4  # self-ping: must be a no-op
     plan = [_inputs(N, manual=manual if i == 1 else None) for i in range(4)]
-    for i, inp in enumerate(plan):
-        man = np.asarray(inp.manual_target)
-        for p in np.nonzero(man >= 0)[0]:
-            mesh.engines[p].pending_manual_pings.append(int(man[p]))
-        mesh.tick()
-        st, metrics = tick_fn(st, inp)
-        _assert_tick_equal(mesh, st, metrics, i)
+    _run_parity(mesh, st, plan)
+
+
+def test_manual_ping_out_of_range_dropped():
+    """An out-of-range manual target (dest >= N) is dropped at the transport,
+    like the oracle's ``0 <= dest < n`` guard — clamped gathers must not fake
+    an exchange with peer N-1."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N)
+    manual = np.full(N, -1, np.int64)
+    manual[0] = N  # out of range: must be a no-op
+    manual[2] = N + 7
+    plan = [_inputs(N, manual=manual if i == 1 else None) for i in range(4)]
+    _run_parity(mesh, st, plan)

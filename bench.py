@@ -1,0 +1,141 @@
+"""Benchmark driver: simulated-peers·ticks/sec/chip + ticks-to-convergence.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: the reference has no published numbers (SURVEY.md §6); its
+demonstrated scale is the 2x2 zellij demo — 4 real peers at 1 tick/second
+(justfile:10-15, kaboodle.rs:38), i.e. an effective 4 simulated-peers·ticks/sec
+on a whole laptop. ``vs_baseline`` is the speedup over that demonstrated rate.
+
+Method: boot N peers knowing only themselves, measure (a) ticks to fingerprint
+convergence and its wall-clock (the north-star quantity, BASELINE.json), then
+(b) steady-state throughput of the fault-free tick kernel under lax.scan,
+compile excluded. Timing forces execution by fetching a scalar to the host:
+on the tunneled TPU backend ``block_until_ready`` does not synchronize, so
+every measurement ends in a device->host fetch and the measured null-fetch
+round-trip is subtracted. Sizes auto-step down if the chip runs out of memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _null_rtt() -> float:
+    """Round-trip of a trivial jitted fetch (tunnel + dispatch overhead)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.float32(0)
+    float(f(x))  # compile
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        float(f(x))
+        return time.perf_counter() - t0
+
+    return min(once() for _ in range(3))
+
+
+def _bench(n: int, ticks: int, warmup: int = 1):
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.runner import run_until_converged, simulate
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    cfg = SwimConfig()
+    st = init_state(n, seed=0)
+    rtt = _null_rtt()
+
+    # (a) convergence: compile first (cached), then time a fresh run. The
+    # int() fetches force real execution through the tunnel.
+    _, conv_ticks, conv = run_until_converged(st, cfg, max_ticks=32)
+    int(conv_ticks)
+    t0 = time.perf_counter()
+    _, conv_ticks, conv = run_until_converged(st, cfg, max_ticks=32)
+    conv_ticks_v = int(conv_ticks)
+    conv_wall = max(time.perf_counter() - t0 - rtt, 0.0)
+
+    # (b) steady-state throughput of the scanned tick kernel. The jitted fn
+    # returns a scalar that depends on the final state, so the whole scan
+    # must execute before the fetch completes.
+    inp = idle_inputs(n, ticks=ticks)
+
+    @jax.jit
+    def run(s, i):
+        out, _ = simulate(s, i, cfg, faulty=False)
+        return out.timer.sum() + out.tick
+
+    for _ in range(max(warmup, 1)):
+        int(run(st, inp))
+    t0 = time.perf_counter()
+    int(run(st, inp))
+    elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
+    return {
+        "converged": bool(conv),
+        "ticks_to_convergence": conv_ticks_v,
+        "convergence_wall_s": conv_wall,
+        "scan_ticks": ticks,
+        "scan_wall_s": elapsed,
+        "peers_ticks_per_sec": n * ticks / elapsed,
+        "null_rtt_s": rtt,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=0, help="peer count (0 = auto by platform)")
+    p.add_argument("--ticks", type=int, default=32)
+    args = p.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    on_tpu = backend not in ("cpu",)
+    sizes = [args.n] if args.n else ([16384, 8192, 4096] if on_tpu else [512])
+
+    result = None
+    used_n = None
+    for n in sizes:
+        try:
+            result = _bench(n, args.ticks)
+            used_n = n
+            break
+        except Exception as e:  # XlaRuntimeError (OOM) -> step down
+            print(f"bench: N={n} failed ({type(e).__name__}: {e}); stepping down",
+                  file=sys.stderr)
+    if result is None:
+        print(json.dumps({"metric": "simulated_peers_ticks_per_sec_per_chip",
+                          "value": 0.0, "unit": "peers*ticks/s/chip",
+                          "vs_baseline": 0.0, "error": "all sizes failed"}))
+        sys.exit(1)
+
+    value = result["peers_ticks_per_sec"] / n_chips
+    # Reference demonstrated rate: 4 peers x 1 tick/s on one whole machine.
+    baseline = 4.0
+    line = {
+        "metric": "simulated_peers_ticks_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "peers*ticks/s/chip",
+        "vs_baseline": round(value / baseline, 2),
+        "n_peers": used_n,
+        "n_chips": n_chips,
+        "backend": backend,
+        "ticks_to_convergence": result["ticks_to_convergence"],
+        "convergence_wall_s": round(result["convergence_wall_s"], 4),
+        "scan_wall_s": round(result["scan_wall_s"], 4),
+        "null_rtt_s": round(result["null_rtt_s"], 4),
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

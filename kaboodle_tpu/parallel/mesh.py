@@ -94,15 +94,20 @@ def _named(mesh: Mesh, specs):
     )
 
 
+def _check_divisible(n: int, mesh: Mesh) -> None:
+    if n % mesh.size != 0:
+        raise ValueError(f"N={n} not divisible by mesh size {mesh.size}")
+
+
 def shard_state(state: MeshState, mesh: Mesh) -> MeshState:
     """Place a MeshState on the mesh (row axis split across ``peers``)."""
-    if state.state.shape[0] % mesh.size != 0:
-        raise ValueError(f"N={state.state.shape[0]} not divisible by mesh size {mesh.size}")
+    _check_divisible(state.state.shape[0], mesh)
     return jax.device_put(state, _named(mesh, state_specs()))
 
 
 def shard_inputs(inputs: TickInputs, mesh: Mesh, stacked: bool = False) -> TickInputs:
     """Place TickInputs on the mesh; pass ``stacked=True`` for scan-stacked [T, ...]."""
+    _check_divisible(inputs.kill.shape[-1], mesh)
     specs = inputs_specs(stacked=stacked, with_drop_ok=inputs.drop_ok is not None)
     return jax.device_put(inputs, _named(mesh, specs))
 

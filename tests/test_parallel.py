@@ -104,3 +104,5 @@ def test_sharded_convergence_matches_and_is_sharded(mesh8):
 def test_mesh_divisibility_check(mesh8):
     with pytest.raises(ValueError):
         shard_state(init_state(30), mesh8)
+    with pytest.raises(ValueError):
+        shard_inputs(idle_inputs(30), mesh8)

@@ -3,6 +3,7 @@
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, init_state, idle_inputs
 from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.runner import simulate, run_until_converged
+from kaboodle_tpu.sim.scenario import Scenario, baseline_scenario
 
 __all__ = [
     "MeshState",
@@ -13,4 +14,6 @@ __all__ = [
     "make_tick_fn",
     "simulate",
     "run_until_converged",
+    "Scenario",
+    "baseline_scenario",
 ]

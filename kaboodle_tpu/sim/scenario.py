@@ -1,0 +1,213 @@
+"""Declarative fault/churn scenarios compiled to stacked per-tick inputs.
+
+The reference has no fault injection at all (SURVEY.md §5): churn is a human
+killing zellij panes, partitions and message drop are untestable. Here the
+whole fault surface is data — a :class:`Scenario` is a schedule of kill /
+revive / partition / drop / manual-ping events that compiles to a
+``TickInputs`` pytree stacked along a leading ``[T]`` axis, ready for
+``lax.scan`` (sim.runner.simulate) or the sharded twin
+(parallel.mesh.simulate_sharded).
+
+Schedules are built host-side with NumPy (they are scenario *inputs*, not
+device work) and are fully deterministic for a given seed: random churn tracks
+the aliveness trajectory while building, so kills always hit live peers and
+revives always resurrect dead ones — the exact alive mask the kernel will
+compute is known in advance (:meth:`Scenario.alive_trajectory`).
+
+The five driver configs (BASELINE.json / BASELINE.md) are provided as named
+constructors via :func:`baseline_scenario`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from kaboodle_tpu.sim.state import TickInputs
+
+
+@dataclasses.dataclass
+class Scenario:
+    """A mutable schedule of fault events over ``ticks`` ticks for ``n`` peers.
+
+    Build with the ``kill_at`` / ``revive_at`` / ``churn`` / ``partition_at`` /
+    ``heal_at`` / ``drop`` / ``manual_ping_at`` methods (each returns ``self``
+    for chaining), then :meth:`build` to get scan-ready ``TickInputs``.
+    """
+
+    n: int
+    ticks: int
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.n < 1 or self.ticks < 1:
+            raise ValueError("need n >= 1 and ticks >= 1")
+        T, n = self.ticks, self.n
+        self._kill = np.zeros((T, n), dtype=bool)
+        self._revive = np.zeros((T, n), dtype=bool)
+        self._partition = np.zeros((T, n), dtype=np.int32)
+        self._drop_rate = np.zeros((T,), dtype=np.float32)
+        self._manual = np.full((T, n), -1, dtype=np.int32)
+        self._initial_alive = np.ones((n,), dtype=bool)
+        self._rng = np.random.default_rng(self.seed)
+
+    # ---- explicit events ---------------------------------------------------
+
+    def start_dead(self, peers) -> "Scenario":
+        """Peers that begin the run dead (joined later via revive_at/churn)."""
+        self._initial_alive[np.asarray(peers)] = False
+        return self
+
+    def kill_at(self, tick: int, peers) -> "Scenario":
+        """Silent leave (quirk Q8: no departure announcement) at ``tick``."""
+        self._kill[tick, np.asarray(peers)] = True
+        return self
+
+    def revive_at(self, tick: int, peers) -> "Scenario":
+        """Rejoin-with-reset at ``tick`` — the peer restarts knowing only
+        itself and re-broadcasts Join (kaboodle.rs:144-152, 228-251)."""
+        self._revive[tick, np.asarray(peers)] = True
+        return self
+
+    def churn(
+        self,
+        rate: float,
+        start: int = 0,
+        stop: int | None = None,
+        protect=(),
+    ) -> "Scenario":
+        """Random join+leave churn: each tick in [start, stop) every live peer
+        dies w.p. ``rate`` and every dead peer rejoins w.p. ``rate`` (the
+        BASELINE config-3 "5%/tick join+leave" schedule). ``protect`` peers
+        never die (keeps at least a stable core so convergence is defined)."""
+        stop = self.ticks if stop is None else stop
+        alive = self._alive_before(start)
+        prot = np.zeros((self.n,), dtype=bool)
+        if len(np.atleast_1d(np.asarray(protect, dtype=np.int64))):
+            prot[np.asarray(protect)] = True
+        for t in range(start, stop):
+            alive = (alive & ~self._kill[t]) | self._revive[t]
+            u = self._rng.random(self.n)
+            kill = alive & ~prot & (u < rate)
+            rev = ~alive & (u < rate)
+            self._kill[t] |= kill
+            self._revive[t] |= rev
+            alive = (alive & ~kill) | rev
+        return self
+
+    def partition_at(self, tick: int, groups, until: int | None = None) -> "Scenario":
+        """Assign partition group ids from ``tick`` until ``until`` (exclusive;
+        default: end of run). Messages cross groups only if ids match."""
+        until = self.ticks if until is None else until
+        self._partition[tick:until] = np.asarray(groups, dtype=np.int32)[None, :]
+        return self
+
+    def heal_at(self, tick: int) -> "Scenario":
+        """Remove all partitions from ``tick`` onward."""
+        self._partition[tick:] = 0
+        return self
+
+    def drop(self, rate: float, start: int = 0, stop: int | None = None) -> "Scenario":
+        """Uniform random per-edge message drop probability over [start, stop)."""
+        stop = self.ticks if stop is None else stop
+        self._drop_rate[start:stop] = rate
+        return self
+
+    def manual_ping_at(self, tick: int, src: int, dst: int) -> "Scenario":
+        """One manual ping (the `ping_addrs` API, lib.rs:268-297)."""
+        self._manual[tick, src] = dst
+        return self
+
+    # ---- derived views -----------------------------------------------------
+
+    def _alive_before(self, tick: int) -> np.ndarray:
+        alive = self._initial_alive.copy()
+        for t in range(tick):
+            alive = (alive & ~self._kill[t]) | self._revive[t]
+        return alive
+
+    def initial_alive(self) -> np.ndarray:
+        """Alive mask to pass to ``init_state`` (bool [N])."""
+        return self._initial_alive.copy()
+
+    def alive_trajectory(self) -> np.ndarray:
+        """bool [T, N]: the post-tick alive mask the kernel will compute."""
+        out = np.zeros((self.ticks, self.n), dtype=bool)
+        alive = self._initial_alive.copy()
+        for t in range(self.ticks):
+            alive = (alive & ~self._kill[t]) | self._revive[t]
+            out[t] = alive
+        return out
+
+    def build(self) -> TickInputs:
+        """Compile to scan-ready ``TickInputs`` stacked along [T]."""
+        import jax.numpy as jnp
+
+        return TickInputs(
+            kill=jnp.asarray(self._kill),
+            revive=jnp.asarray(self._revive),
+            partition=jnp.asarray(self._partition),
+            drop_rate=jnp.asarray(self._drop_rate),
+            manual_target=jnp.asarray(self._manual),
+            drop_ok=None,
+        )
+
+
+def baseline_scenario(config: int, n: int | None = None, ticks: int | None = None, seed: int = 0) -> Scenario:
+    """The five driver configs from BASELINE.json as scenarios.
+
+    ``n``/``ticks`` override the driver-specified scale (tests run scaled-down
+    replicas of the same shapes). Config numbers are 1-based as in BASELINE.md.
+
+    1. 4-peer demo mesh, fault-free (the 2x2 zellij demo, justfile:10-15).
+    2. 1,024 peers, no churn (ticks-to-convergence measurement).
+    3. 8,192 peers, 5%/tick join+leave churn for the first half, then calm
+       (exercises the suspicion / indirect-ping / removal path).
+    4. 65,536 peers, fault-free (run sharded: ICI all-reduce fingerprint check).
+    5. 65,536 peers, 10% random message drop + a 2-way partition over the
+       middle third; both faults heal at the final third and the mesh
+       re-converges.
+
+    Two protocol properties bound what config 5 can assert (both faithful to
+    the reference, verified against the kernel):
+
+    - *Sustained drop precludes instantaneous agreement.* In faithful mode a
+      forwarded indirect Ack marks the **proxy** Known, not the suspect
+      (quirk Q11, kaboodle.rs:408-415 applies to the datagram's sender), so a
+      suspicion only clears if the suspect happens to message the suspector
+      directly within the timeout. Under p=10% loss each peer-tick has ~2%
+      chance of a false removal (later healed by any datagram from the
+      removed peer, Q1) — at N=65,536 that is ~10^3 membership flips per
+      tick, so the convergence predicate (min fingerprint == max) is
+      essentially never true *while* drop is active. Hence the fault window
+      closes before convergence is measured.
+    - *Partitions must heal before mutual purge completes.* Removal is purely
+      local timeout (Failed broadcasts are inert, Q3) and only lonely peers
+      rebroadcast Join (kaboodle.rs:228-251), so if two sides fully purge
+      each other there is no re-merge path — in the reference exactly as
+      here. Purge throughput is ~1 removal/peer/tick after the pipeline
+      fills, so the partition window must be < (peers behind the partition)
+      ticks. At the driver's scale (32,768 behind the cut, 32-tick window)
+      this holds by 3 orders of magnitude; scaled-down replicas must scale
+      the window too (see tests/test_scenario.py).
+    """
+    if config == 1:
+        sc = Scenario(n or 4, ticks or 16, seed)
+    elif config == 2:
+        sc = Scenario(n or 1024, ticks or 32, seed)
+    elif config == 3:
+        sc = Scenario(n or 8192, ticks or 64, seed)
+        sc.churn(0.05, start=1, stop=sc.ticks // 2, protect=[0])
+    elif config == 4:
+        sc = Scenario(n or 65536, ticks or 32, seed)
+    elif config == 5:
+        sc = Scenario(n or 65536, ticks or 96, seed)
+        third = sc.ticks // 3
+        sc.drop(0.10, stop=2 * third)
+        groups = (np.arange(sc.n) % 2).astype(np.int32)
+        sc.partition_at(third, groups, until=2 * third)
+        sc.heal_at(2 * third)
+    else:
+        raise ValueError(f"unknown baseline config {config!r} (want 1-5)")
+    return sc

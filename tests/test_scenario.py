@@ -1,0 +1,116 @@
+"""Scenario layer: schedules compile correctly and drive the kernel as declared.
+
+These are the assertable versions of the reference's eyeball checks (SURVEY.md
+§4): churn (BASELINE config 3), message drop and partition-heal (config 5)
+become deterministic scan runs with asserted convergence behavior.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.runner import simulate
+from kaboodle_tpu.sim.scenario import Scenario, baseline_scenario
+from kaboodle_tpu.sim.state import init_state
+
+
+def test_schedule_invariants_and_alive_trajectory():
+    sc = Scenario(n=16, ticks=30, seed=1).start_dead([3, 4]).churn(0.2, protect=[0])
+    alive = sc.initial_alive()
+    # kills only ever hit live peers; revives only dead ones; peer 0 protected.
+    for t in range(sc.ticks):
+        assert not np.any(sc._kill[t] & ~alive)
+        assert not np.any(sc._revive[t] & alive)
+        assert not sc._kill[t][0]
+        alive = (alive & ~sc._kill[t]) | sc._revive[t]
+    assert np.array_equal(alive, sc.alive_trajectory()[-1])
+
+    # The kernel computes exactly the predicted aliveness.
+    st = init_state(sc.n, alive=jnp.asarray(sc.initial_alive()))
+    final, _ = simulate(st, sc.build(), SwimConfig())
+    assert np.array_equal(np.asarray(final.alive), sc.alive_trajectory()[-1])
+
+
+def test_full_drop_blocks_everything():
+    sc = Scenario(n=8, ticks=5).drop(1.0)
+    st = init_state(sc.n)
+    final, m = simulate(st, sc.build(), SwimConfig())
+    assert int(jnp.sum(m.messages_delivered)) == 0
+    assert not bool(m.converged[-1])
+    # Nobody learned anybody: membership stays the identity.
+    assert int(jnp.sum(final.state > 0)) == sc.n
+
+
+def test_churn_then_calm_reconverges():
+    """Config-3 shape at test scale: churn storm, then the mesh heals itself
+    via the suspicion -> indirect-ping -> removal path (kaboodle.rs:558-653)."""
+    n, ticks = 32, 140
+    sc = Scenario(n=n, ticks=ticks, seed=5).churn(0.05, start=1, stop=20, protect=[0])
+    st = init_state(n, seed=5)
+    final, m = simulate(st, sc.build(), SwimConfig())
+    assert bool(m.converged[-1]), (
+        f"agree={float(m.agree_fraction[-1])} fpmin={int(m.fingerprint_min[-1])} "
+        f"fpmax={int(m.fingerprint_max[-1])}"
+    )
+    # Every alive peer's map contains exactly the alive set (dead peers were
+    # detected and removed; revived peers were re-discovered).
+    alive = np.asarray(final.alive)
+    member = np.asarray(final.state) > 0
+    for i in np.flatnonzero(alive):
+        assert np.array_equal(member[i], alive), f"peer {i}"
+
+
+def test_partition_diverges_then_heals():
+    """Config-5 shape at test scale: converge, partition even/odd (fingerprints
+    diverge via cross-group removals), heal, re-converge (Q1: any inbound
+    datagram resurrects the sender, kaboodle.rs:408-415)."""
+    n = 16
+    warm, part, heal_run = 20, 12, 60
+    ticks = warm + part + heal_run
+    groups = (np.arange(n) % 2).astype(np.int32)
+    sc = Scenario(n=n, ticks=ticks, seed=2)
+    sc.partition_at(warm, groups, until=warm + part)
+    st = init_state(n, seed=2)
+    final, m = simulate(st, sc.build(), SwimConfig())
+
+    conv = np.asarray(m.converged)
+    assert conv[warm - 1], "should converge before the partition"
+    assert not conv[warm + part - 1], "partition should break agreement"
+    assert conv[-1], "should re-converge after heal"
+    member = np.asarray(final.state) > 0
+    assert member.all(axis=1).all(), "every peer re-learns the full mesh"
+
+
+def test_baseline_scenarios_construct():
+    for cfg_id, n in [(1, None), (2, 64), (3, 64), (4, 64), (5, 66)]:
+        sc = baseline_scenario(cfg_id, n=n, ticks=12)
+        inp = sc.build()
+        assert inp.kill.shape == (12, sc.n)
+        assert inp.partition.shape == (12, sc.n)
+    with pytest.raises(ValueError):
+        baseline_scenario(0)
+
+
+def test_baseline_config5_has_partition_and_drop():
+    sc = baseline_scenario(5, n=12, ticks=9)
+    inp = sc.build()
+    assert float(inp.drop_rate[0]) == pytest.approx(0.10)
+    third = 3
+    assert int(jnp.max(inp.partition[third])) == 1  # partitioned middle third
+    assert int(jnp.max(inp.partition[2 * third])) == 0  # healed
+    assert float(inp.drop_rate[2 * third]) == 0.0  # drop window closed too
+
+
+def test_drop_plus_partition_heal_reconverges():
+    """Config-5 shape at test scale (windows scaled per the purge bound — see
+    scenario.py): 10% drop + even/odd partition, both heal, mesh re-converges
+    with full membership."""
+    n = 32
+    sc = Scenario(n=n, ticks=130, seed=3).drop(0.10, stop=42)
+    groups = (np.arange(n) % 2).astype(np.int32)
+    sc.partition_at(30, groups, until=42).heal_at(42)
+    final, m = simulate(init_state(n, seed=3), sc.build(), SwimConfig())
+    assert bool(m.converged[-1])
+    assert float(m.agree_fraction[-1]) == 1.0
+    assert (np.asarray(final.state) > 0).all(), "every peer re-learns the full mesh"

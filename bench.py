@@ -42,7 +42,7 @@ def _null_rtt() -> float:
     return min(once() for _ in range(3))
 
 
-def _bench(n: int, ticks: int, warmup: int = 1):
+def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -54,23 +54,54 @@ def _bench(n: int, ticks: int, warmup: int = 1):
     st = init_state(n, seed=0)
     rtt = _null_rtt()
 
+    if sharded:
+        from kaboodle_tpu.parallel import (
+            make_mesh,
+            run_until_converged_sharded,
+            shard_inputs,
+            shard_state,
+            simulate_sharded,
+        )
+
+        mesh = make_mesh()
+        st = shard_state(st, mesh)
+
+        def _converge(s):
+            return run_until_converged_sharded(s, cfg, mesh, max_ticks=32)
+
+        def _scan(s, i):
+            return simulate_sharded(s, i, cfg, mesh, faulty=False)
+
+        def _place_inputs(i):
+            return shard_inputs(i, mesh, stacked=True)
+    else:
+
+        def _converge(s):
+            return run_until_converged(s, cfg, max_ticks=32)
+
+        def _scan(s, i):
+            return simulate(s, i, cfg, faulty=False)
+
+        def _place_inputs(i):
+            return i
+
     # (a) convergence: compile first (cached), then time a fresh run. The
     # int() fetches force real execution through the tunnel.
-    _, conv_ticks, conv = run_until_converged(st, cfg, max_ticks=32)
+    _, conv_ticks, conv = _converge(st)
     int(conv_ticks)
     t0 = time.perf_counter()
-    _, conv_ticks, conv = run_until_converged(st, cfg, max_ticks=32)
+    _, conv_ticks, conv = _converge(st)
     conv_ticks_v = int(conv_ticks)
     conv_wall = max(time.perf_counter() - t0 - rtt, 0.0)
 
     # (b) steady-state throughput of the scanned tick kernel. The jitted fn
     # returns a scalar that depends on the final state, so the whole scan
     # must execute before the fetch completes.
-    inp = idle_inputs(n, ticks=ticks)
+    inp = _place_inputs(idle_inputs(n, ticks=ticks))
 
     @jax.jit
     def run(s, i):
-        out, _ = simulate(s, i, cfg, faulty=False)
+        out, _ = _scan(s, i)
         return out.timer.sum() + out.tick
 
     for _ in range(max(warmup, 1)):
@@ -102,21 +133,33 @@ def main() -> None:
     on_tpu = backend not in ("cpu",)
     sizes = [args.n] if args.n else ([16384, 8192, 4096] if on_tpu else [512])
 
+    # Engage every chip when there are several (the sharded GSPMD path);
+    # single-chip runs use the plain kernel.
+    sharded = n_chips > 1
+    if sharded:
+        adjusted = [max(n_chips, n - n % n_chips) for n in sizes]
+        if args.n and adjusted[0] != args.n:
+            print(f"bench: --n {args.n} adjusted to {adjusted[0]} "
+                  f"(multiple of {n_chips} chips)", file=sys.stderr)
+        sizes = adjusted
+
     result = None
     used_n = None
     for n in sizes:
         try:
-            result = _bench(n, args.ticks)
+            result = _bench(n, args.ticks, sharded=sharded)
             used_n = n
             break
-        except Exception as e:  # XlaRuntimeError (OOM) -> step down
-            print(f"bench: N={n} failed ({type(e).__name__}: {e}); stepping down",
+        except Exception as e:
+            # Step down only on memory exhaustion; anything else is a real
+            # bug and must surface as a traceback, not "all sizes failed".
+            msg = str(e)
+            oom = ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+                   or "Allocat" in msg)
+            if not oom or n == sizes[-1]:
+                raise
+            print(f"bench: N={n} OOM ({type(e).__name__}); stepping down",
                   file=sys.stderr)
-    if result is None:
-        print(json.dumps({"metric": "simulated_peers_ticks_per_sec_per_chip",
-                          "value": 0.0, "unit": "peers*ticks/s/chip",
-                          "vs_baseline": 0.0, "error": "all sizes failed"}))
-        sys.exit(1)
 
     value = result["peers_ticks_per_sec"] / n_chips
     # Reference demonstrated rate: 4 peers x 1 tick/s on one whole machine.
@@ -128,7 +171,9 @@ def main() -> None:
         "vs_baseline": round(value / baseline, 2),
         "n_peers": used_n,
         "n_chips": n_chips,
+        "sharded": sharded,
         "backend": backend,
+        "converged": result["converged"],
         "ticks_to_convergence": result["ticks_to_convergence"],
         "convergence_wall_s": round(result["convergence_wall_s"], 4),
         "scan_wall_s": round(result["scan_wall_s"], 4),

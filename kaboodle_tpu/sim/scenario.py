@@ -10,9 +10,10 @@ revive / partition / drop / manual-ping events that compiles to a
 
 Schedules are built host-side with NumPy (they are scenario *inputs*, not
 device work) and are fully deterministic for a given seed: random churn tracks
-the aliveness trajectory while building, so kills always hit live peers and
-revives always resurrect dead ones — the exact alive mask the kernel will
-compute is known in advance (:meth:`Scenario.alive_trajectory`).
+the aliveness trajectory while building, so for a sole churn schedule kills
+always hit live peers and revives always resurrect dead ones (overlapping
+schedules guarantee the weaker contract: the exact alive mask the kernel will
+compute is still known in advance — :meth:`Scenario.alive_trajectory`).
 
 The five driver configs (BASELINE.json / BASELINE.md) are provided as named
 constructors via :func:`baseline_scenario`.
@@ -84,16 +85,23 @@ class Scenario:
         stop = self.ticks if stop is None else stop
         alive = self._alive_before(start)
         prot = np.zeros((self.n,), dtype=bool)
-        if len(np.atleast_1d(np.asarray(protect, dtype=np.int64))):
-            prot[np.asarray(protect)] = True
+        prot[np.asarray(protect, dtype=np.int64)] = True
         for t in range(start, stop):
-            alive = (alive & ~self._kill[t]) | self._revive[t]
+            # Overlapping churn windows shift the aliveness trajectory that
+            # earlier-scheduled events assumed, so first sanitize this tick's
+            # pre-existing events against the actual trajectory (a kill of an
+            # already-dead peer is a no-op; a revive of an alive peer would be
+            # a surprise restart-with-reset), then draw new events only for
+            # untouched peers — keeping the schedule invariants exact under
+            # the kernel's revive-wins (alive & ~kill) | revive rule.
+            self._kill[t] &= alive
+            self._revive[t] &= ~alive
+            untouched = ~self._kill[t] & ~self._revive[t]
+            cur = (alive & ~self._kill[t]) | self._revive[t]
             u = self._rng.random(self.n)
-            kill = alive & ~prot & (u < rate)
-            rev = ~alive & (u < rate)
-            self._kill[t] |= kill
-            self._revive[t] |= rev
-            alive = (alive & ~kill) | rev
+            self._kill[t] |= cur & untouched & ~prot & (u < rate)
+            self._revive[t] |= ~cur & untouched & (u < rate)
+            alive = (alive & ~self._kill[t]) | self._revive[t]
         return self
 
     def partition_at(self, tick: int, groups, until: int | None = None) -> "Scenario":
@@ -206,7 +214,7 @@ def baseline_scenario(config: int, n: int | None = None, ticks: int | None = Non
         third = sc.ticks // 3
         sc.drop(0.10, stop=2 * third)
         groups = (np.arange(sc.n) % 2).astype(np.int32)
-        sc.partition_at(third, groups, until=2 * third)
+        sc.partition_at(third, groups)
         sc.heal_at(2 * third)
     else:
         raise ValueError(f"unknown baseline config {config!r} (want 1-5)")

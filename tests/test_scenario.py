@@ -32,6 +32,23 @@ def test_schedule_invariants_and_alive_trajectory():
     assert np.array_equal(np.asarray(final.alive), sc.alive_trajectory()[-1])
 
 
+def test_composed_churn_trajectory_still_exact():
+    """Overlapping churn windows: per-event invariants are only guaranteed for
+    a sole schedule, but the trajectory prediction and protection must stay
+    exact under the kernel's revive-wins (alive & ~kill) | revive rule."""
+    sc = (
+        Scenario(n=16, ticks=30, seed=1)
+        .start_dead([3, 4])
+        .churn(0.2, protect=[0])
+        .churn(0.3, start=5, stop=25, protect=[0])
+    )
+    traj = sc.alive_trajectory()
+    assert traj[:, 0].all(), "protected peer stays alive"
+    st = init_state(sc.n, alive=jnp.asarray(sc.initial_alive()))
+    final, _ = simulate(st, sc.build(), SwimConfig())
+    assert np.array_equal(np.asarray(final.alive), traj[-1])
+
+
 def test_full_drop_blocks_everything():
     sc = Scenario(n=8, ticks=5).drop(1.0)
     st = init_state(sc.n)
@@ -109,7 +126,7 @@ def test_drop_plus_partition_heal_reconverges():
     n = 32
     sc = Scenario(n=n, ticks=130, seed=3).drop(0.10, stop=42)
     groups = (np.arange(n) % 2).astype(np.int32)
-    sc.partition_at(30, groups, until=42).heal_at(42)
+    sc.partition_at(30, groups).heal_at(42)
     final, m = simulate(init_state(n, seed=3), sc.build(), SwimConfig())
     assert bool(m.converged[-1])
     assert float(m.agree_fraction[-1]) == 1.0

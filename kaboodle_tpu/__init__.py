@@ -19,7 +19,18 @@ Public API mirrors the reference's ``Kaboodle`` facade (lib.rs:78-369): see
 """
 
 from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.errors import KaboodleError
 
 __version__ = "0.1.0"
 
-__all__ = ["SwimConfig", "__version__"]
+__all__ = ["SwimConfig", "KaboodleError", "Kaboodle", "SimNetwork", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy: the facade pulls in the sim stack; plain `import kaboodle_tpu`
+    # (e.g. for config/spec constants) should not.
+    if name in ("Kaboodle", "SimNetwork"):
+        from kaboodle_tpu import api
+
+        return getattr(api, name)
+    raise AttributeError(name)

@@ -1,0 +1,309 @@
+"""The consumer-facing ``Kaboodle`` facade over the simulated mesh.
+
+Mirrors the reference's public API (lib.rs:78-369): lifecycle
+(``start``/``stop``), queries (``peers``, ``peer_states``, ``fingerprint``),
+manual bootstrap (``ping_addrs``), identity management (``set_identity``), and
+the three discovery event streams (``discover_peers``,
+``discover_departures``, ``discover_fingerprint_changes``,
+``discover_next_peer``).
+
+Where the reference binds one instance per OS process to a UDP socket, here
+many instances attach to one :class:`SimNetwork` — the in-memory "LAN" whose
+medium is the ``[N, N]`` state tensor and whose clock is the tick kernel. An
+instance's "address" is its peer index. Instances advance together:
+``SimNetwork.tick()`` steps the whole mesh one protocol period (the lockstep
+twin of every reference instance's independent 1 Hz tokio loop) and then
+delivers events to each attached instance's streams.
+
+Documented deviations from the reference:
+
+- *Identity words are globally visible* (D-API1): the kernel keeps one
+  ``identity[N]`` vector shared by all rows, so a ``set_identity`` is seen by
+  every peer's fingerprint immediately instead of spreading via envelopes.
+  Consumer-supplied identity *bytes* are kept host-side per network and
+  resolved in ``peers()``; the on-device word is their CRC-32.
+- *Restart keeps the address* (D-API2): the reference re-binds an ephemeral
+  port on ``start()`` after ``stop()`` (a new address); a simulated instance
+  keeps its index, re-entering with a reset row + Join broadcast — the same
+  protocol behavior, minus the address churn.
+- ``stop()`` is a silent leave (quirk Q8 — no departure announcement), and
+  the instance keeps its membership map while stopped (lib.rs:167-170).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.errors import ConvergenceTimeout, InvalidOperation
+from kaboodle_tpu.events import EventTap, FingerprintChanged, PeerDeparted, PeerDiscovered
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, init_state
+from kaboodle_tpu.spec import STATE_NAMES
+
+
+def _identity_word(identity) -> int:
+    """Consumer identity -> on-device uint32 word (CRC-32 of the bytes)."""
+    if isinstance(identity, int):
+        return identity & 0xFFFFFFFF
+    if isinstance(identity, str):
+        identity = identity.encode()
+    return zlib.crc32(bytes(identity)) & 0xFFFFFFFF
+
+
+class SimNetwork:
+    """The shared medium: N peer slots, the tick clock, and fault controls.
+
+    All peers start dead; :meth:`Kaboodle.start` revives a slot (the kernel
+    resets its row and schedules a Join broadcast, kaboodle.rs:144-152,
+    228-251). Fault controls (``set_drop_rate``, ``set_partition``) take
+    effect on subsequent ticks — the interactive twin of the declarative
+    :class:`kaboodle_tpu.sim.Scenario`.
+    """
+
+    def __init__(self, capacity: int, cfg: SwimConfig | None = None, seed: int = 0):
+        self.cfg = cfg or SwimConfig()
+        self.capacity = capacity
+        self.state: MeshState = init_state(
+            capacity, seed=seed, alive=jnp.zeros((capacity,), dtype=bool)
+        )
+        self._tick_fn = make_tick_fn(self.cfg, faulty=True)
+        self._instances: dict[int, "Kaboodle"] = {}
+        self._next_slot = 0
+        # pending per-tick control inputs, drained by tick()
+        self._kill = np.zeros(capacity, dtype=bool)
+        self._revive = np.zeros(capacity, dtype=bool)
+        self._manual: dict[int, collections.deque[int]] = collections.defaultdict(
+            collections.deque
+        )
+        self._partition = np.zeros(capacity, dtype=np.int32)
+        self._drop_rate = 0.0
+        self.metrics: TickMetrics | None = None  # last tick's metrics
+
+    # ---- slots -------------------------------------------------------------
+
+    def _attach(self, inst: "Kaboodle") -> int:
+        if self._next_slot >= self.capacity:
+            raise InvalidOperation(f"network is full ({self.capacity} slots)")
+        slot = self._next_slot
+        self._next_slot += 1
+        self._instances[slot] = inst
+        return slot
+
+    # ---- fault controls ----------------------------------------------------
+
+    def set_drop_rate(self, rate: float) -> None:
+        """Random per-edge message drop probability for subsequent ticks."""
+        self._drop_rate = float(rate)
+
+    def set_partition(self, groups) -> None:
+        """Partition group ids (int [capacity]); equal ids communicate."""
+        self._partition = np.asarray(groups, dtype=np.int32).copy()
+
+    def heal_partition(self) -> None:
+        self._partition[:] = 0
+
+    # ---- the clock ---------------------------------------------------------
+
+    def tick(self, ticks: int = 1) -> TickMetrics:
+        """Advance the whole mesh ``ticks`` protocol periods and deliver events."""
+        for _ in range(ticks):
+            n = self.capacity
+            manual = np.full(n, -1, dtype=np.int32)
+            for slot, q in self._manual.items():
+                if q:
+                    manual[slot] = q.popleft()
+            # NB: copies are load-bearing — jnp.asarray may alias a NumPy
+            # buffer on CPU, and the pending masks are cleared right below.
+            inp = TickInputs(
+                kill=jnp.asarray(self._kill.copy()),
+                revive=jnp.asarray(self._revive.copy()),
+                partition=jnp.asarray(self._partition.copy()),
+                drop_rate=jnp.float32(self._drop_rate),
+                manual_target=jnp.asarray(manual),
+                drop_ok=None,
+            )
+            self._kill[:] = False
+            self._revive[:] = False
+            self.state, self.metrics = self._tick_fn(self.state, inp)
+            self._deliver_events()
+        return self.metrics
+
+    def tick_until_converged(self, max_ticks: int = 64) -> int:
+        """Tick until the alive peers agree on the fingerprint; returns ticks
+        run. Raises InvalidOperation if nothing is running and
+        ConvergenceTimeout if agreement is not reached within ``max_ticks``."""
+        if not any(i.is_running for i in self._instances.values()):
+            raise InvalidOperation("no running instances")
+        for t in range(1, max_ticks + 1):
+            m = self.tick()
+            if bool(m.converged):
+                return t
+        raise ConvergenceTimeout(f"no fingerprint agreement within {max_ticks} ticks")
+
+    def _deliver_events(self) -> None:
+        member = np.asarray(self.state.state > 0)
+        ids = np.asarray(self.state.identity)
+        for slot, inst in self._instances.items():
+            if inst.is_running:
+                inst._dispatch(inst._tap.feed(member[slot], ids))
+
+
+class Kaboodle:
+    """One mesh instance — the reference's ``Kaboodle`` struct (lib.rs:78-92).
+
+    ``identity`` is an opaque consumer payload (bytes/str/int), as in the
+    reference (README.md:15); it travels as a 32-bit word on device (D-API1).
+    """
+
+    def __init__(self, network: SimNetwork, identity=b"") -> None:
+        self._net = network
+        self._slot = network._attach(self)
+        self._identity = identity
+        self._running = False
+        self._tap = EventTap()
+        self._discover_subs: list[collections.deque] = []
+        self._depart_subs: list[collections.deque] = []
+        self._fp_subs: list[collections.deque] = []
+        network.state = dataclasses.replace(
+            network.state,
+            identity=network.state.identity.at[self._slot].set(_identity_word(identity)),
+        )
+
+    # ---- lifecycle (lib.rs:136-183) ---------------------------------------
+
+    def start(self) -> None:
+        """Join the mesh: reset row, revive, Join broadcast on the next tick."""
+        if self._running:
+            raise InvalidOperation("already running")
+        self._net._revive[self._slot] = True
+        self._net._kill[self._slot] = False  # cancel a not-yet-applied stop
+        self._running = True
+
+    def stop(self) -> None:
+        """Silent leave (Q8). The membership map is kept (lib.rs:167-170)."""
+        if not self._running:
+            raise InvalidOperation("not running")
+        self._net._kill[self._slot] = True
+        self._net._revive[self._slot] = False  # cancel a not-yet-applied start
+        self._running = False
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    # ---- addressing --------------------------------------------------------
+
+    def self_addr(self) -> int:
+        """The instance's address: its peer index (lib.rs:339-345 analogue)."""
+        return self._slot
+
+    def interface(self) -> str:
+        """The 'interface' the instance is bound to (lib.rs analogue)."""
+        return "sim"
+
+    # ---- queries (lib.rs:301-354) -----------------------------------------
+
+    def _row(self) -> np.ndarray:
+        return np.asarray(self._net.state.state[self._slot])
+
+    def peers(self) -> dict[int, object]:
+        """Membership map: peer index -> identity payload (lib.rs:339-345).
+
+        Identity payloads are resolved to the attached instance's consumer
+        bytes where known; detached slots report their raw identity word."""
+        ids = np.asarray(self._net.state.identity)
+        out: dict[int, object] = {}
+        for j in np.flatnonzero(self._row() > 0):
+            inst = self._net._instances.get(int(j))
+            out[int(j)] = inst._identity if inst is not None else int(ids[j])
+        return out
+
+    def peer_states(self) -> dict[int, tuple[str, int]]:
+        """peer index -> (state name, last-heard/sent-at tick) (lib.rs:348-354).
+
+        The reference also reports a latency EWMA (kaboodle.rs:789-817); the
+        lockstep simulator's latency is identically one tick, so the timing
+        column here is the tick stamp instead."""
+        row = self._row()
+        timer = np.asarray(self._net.state.timer[self._slot])
+        return {
+            int(j): (STATE_NAMES[int(row[j])], int(timer[j]))
+            for j in np.flatnonzero(row > 0)
+        }
+
+    def fingerprint(self) -> int:
+        """Current mesh fingerprint of this instance's view (lib.rs:301-304)."""
+        from kaboodle_tpu.oracle.fingerprint import mix_fingerprint
+
+        ids = np.asarray(self._net.state.identity)
+        return mix_fingerprint(
+            {int(j): int(ids[j]) for j in np.flatnonzero(self._row() > 0)}
+        )
+
+    # ---- identity (lib.rs:323-336) ----------------------------------------
+
+    def set_identity(self, identity) -> None:
+        self._identity = identity
+        self._net.state = dataclasses.replace(
+            self._net.state,
+            identity=self._net.state.identity.at[self._slot].set(_identity_word(identity)),
+        )
+
+    # ---- manual pings (lib.rs:268-297) ------------------------------------
+
+    def ping_addrs(self, addrs) -> None:
+        """Queue manual pings, one per subsequent tick (kaboodle.rs:550-556)."""
+        if not self._running:
+            raise InvalidOperation("not running")
+        for a in addrs:
+            self._net._manual[self._slot].append(int(a))
+
+    # ---- event streams (lib.rs:186-263) -----------------------------------
+
+    def discover_peers(self):
+        """Subscribe to (peer, identity) discoveries; returns a deque that
+        fills as the network ticks (the reference's mpsc channel)."""
+        q: collections.deque = collections.deque()
+        self._discover_subs.append(q)
+        return q
+
+    def discover_departures(self):
+        q: collections.deque = collections.deque()
+        self._depart_subs.append(q)
+        return q
+
+    def discover_fingerprint_changes(self):
+        q: collections.deque = collections.deque()
+        self._fp_subs.append(q)
+        return q
+
+    def discover_next_peer(self, max_ticks: int = 64):
+        """Tick the network until this instance discovers a peer; returns
+        (peer, identity) or None after ``max_ticks`` (lib.rs:246-260)."""
+        q = self.discover_peers()
+        try:
+            for _ in range(max_ticks):
+                if q:
+                    break
+                self._net.tick()
+            return q.popleft() if q else None
+        finally:
+            self._discover_subs.remove(q)
+
+    def _dispatch(self, events) -> None:
+        for e in events:
+            if isinstance(e, PeerDiscovered):
+                for q in self._discover_subs:
+                    q.append((e.peer, e.identity))
+            elif isinstance(e, PeerDeparted):
+                for q in self._depart_subs:
+                    q.append(e.peer)
+            elif isinstance(e, FingerprintChanged):
+                for q in self._fp_subs:
+                    q.append(e.fingerprint)

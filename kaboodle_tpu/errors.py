@@ -1,0 +1,39 @@
+"""Error types mirroring the reference's ``KaboodleError`` enum (errors.rs:8-24).
+
+Python exceptions replace the Rust enum; variants map one-to-one. ``IoError``
+wraps OS-level failures from the real transport (the simulator cannot produce
+them), and the two interface errors only arise in the interop path
+(networking.rs:12-23, 68-119).
+"""
+
+from __future__ import annotations
+
+
+class KaboodleError(Exception):
+    """Base class for all framework errors (errors.rs:8)."""
+
+
+class InvalidOperation(KaboodleError):
+    """Operation not valid in the current lifecycle state — e.g. starting a
+    running instance or stopping a stopped one (errors.rs:10-11)."""
+
+
+class IoError(KaboodleError):
+    """Wrapped OS/transport error (errors.rs:13-14)."""
+
+
+class NoAvailableInterfaces(KaboodleError):
+    """No usable non-loopback network interface (errors.rs:16-17)."""
+
+
+class UnableToFindInterfaceNumber(KaboodleError):
+    """Interface index lookup failed for IPv6 multicast join (errors.rs:19-20)."""
+
+
+class StoppingFailed(KaboodleError):
+    """The protocol loop did not acknowledge cancellation (errors.rs:22-23)."""
+
+
+class ConvergenceTimeout(KaboodleError):
+    """Simulator-specific (no reference equivalent): a bounded convergence
+    drive ended without fingerprint agreement."""

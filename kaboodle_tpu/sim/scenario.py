@@ -87,15 +87,11 @@ class Scenario:
         prot = np.zeros((self.n,), dtype=bool)
         prot[np.asarray(protect, dtype=np.int64)] = True
         for t in range(start, stop):
-            # Overlapping churn windows shift the aliveness trajectory that
-            # earlier-scheduled events assumed, so first sanitize this tick's
-            # pre-existing events against the actual trajectory (a kill of an
-            # already-dead peer is a no-op; a revive of an alive peer would be
-            # a surprise restart-with-reset), then draw new events only for
-            # untouched peers — keeping the schedule invariants exact under
-            # the kernel's revive-wins (alive & ~kill) | revive rule.
-            self._kill[t] &= alive
-            self._revive[t] &= ~alive
+            # Pre-existing events are never rewritten (an explicit revive_at
+            # of an alive peer is a deliberate restart-with-reset); churn only
+            # draws for peers with no event this tick, and tracks aliveness
+            # with the kernel's own revive-wins (alive & ~kill) | revive rule
+            # so alive_trajectory() stays exact under composition.
             untouched = ~self._kill[t] & ~self._revive[t]
             cur = (alive & ~self._kill[t]) | self._revive[t]
             u = self._rng.random(self.n)

@@ -1,0 +1,103 @@
+"""Event derivation semantics (events.rs:18-125) over tensor diffs."""
+
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.events import (
+    EventTap,
+    FingerprintChanged,
+    PeerDeparted,
+    PeerDiscovered,
+    membership_diff,
+)
+from kaboodle_tpu.oracle.fingerprint import mix_fingerprint
+from kaboodle_tpu.sim import init_state, simulate, idle_inputs
+
+
+IDS = np.arange(1, 9, dtype=np.uint32)
+
+
+def _member(*peers, n=8):
+    m = np.zeros(n, dtype=bool)
+    m[list(peers)] = True
+    return m
+
+
+def test_initial_feed_announces_everything():
+    tap = EventTap()
+    ev = tap.feed(_member(0, 2), IDS)
+    assert PeerDiscovered(0, 1) in ev and PeerDiscovered(2, 3) in ev
+    fps = [e for e in ev if isinstance(e, FingerprintChanged)]
+    assert fps == [FingerprintChanged(mix_fingerprint({0: 1, 2: 3}))]
+
+
+def test_no_change_no_events_and_batching():
+    tap = EventTap()
+    tap.feed(_member(0, 1), IDS)
+    # A remove+re-add inside one batch nets to no change (events.rs:88-99).
+    assert tap.feed(_member(0, 1), IDS) == []
+
+
+def test_departure_and_fingerprint_dedup():
+    tap = EventTap()
+    tap.feed(_member(0, 1, 2), IDS)
+    ev = tap.feed(_member(0, 1), IDS)
+    assert PeerDeparted(2) in ev
+    assert FingerprintChanged(mix_fingerprint({0: 1, 1: 2})) in ev
+    # Going back to the old membership re-announces (differs from last).
+    ev2 = tap.feed(_member(0, 1, 2), IDS)
+    assert FingerprintChanged(mix_fingerprint({0: 1, 1: 2, 2: 3})) in ev2
+
+
+def test_identity_change_reannounces():
+    tap = EventTap()
+    tap.feed(_member(0, 1), IDS)
+    ids2 = IDS.copy()
+    ids2[1] = 99
+    ev = tap.feed(_member(0, 1), ids2)
+    assert PeerDiscovered(1, 99) in ev
+    assert any(isinstance(e, FingerprintChanged) for e in ev)
+    # An identity change of a non-member is ignored (events.rs:80-87).
+    ids3 = ids2.copy()
+    ids3[5] = 7
+    assert tap.feed(_member(0, 1), ids3) == []
+
+
+def test_empty_map_fingerprint_suppressed():
+    """Quirk Q10: fp of an empty map is 0 and never announced."""
+    tap = EventTap()
+    tap.feed(_member(0), IDS)
+    ev = tap.feed(_member(), IDS)
+    assert PeerDeparted(0) in ev
+    assert not any(isinstance(e, FingerprintChanged) for e in ev)
+
+
+def test_membership_diff_matches_tap():
+    prev, cur = _member(0, 1, 2), _member(0, 2, 4)
+    added, removed = membership_diff(prev[None, :], cur[None, :])
+    assert np.flatnonzero(added[0]).tolist() == [4]
+    assert np.flatnonzero(removed[0]).tolist() == [1]
+
+
+def test_tap_over_simulated_run():
+    """Feeding per-tick rows of a real run: observer 0 discovers the whole
+    mesh; the last announced fingerprint matches the final converged state."""
+    n = 16
+    st = init_state(n, seed=4)
+    final, _ = simulate(st, idle_inputs(n, ticks=6), SwimConfig(), faulty=False)
+    # Re-run tick by tick to snapshot rows (scan output only has the final).
+    tap = EventTap()
+    discovered = set()
+    st_t = init_state(n, seed=4)
+    ids = np.asarray(st_t.identity)
+    seen_fp = None
+    for t in range(6):
+        st_t, _ = simulate(st_t, idle_inputs(n, ticks=1), SwimConfig(), faulty=False)
+        for e in tap.feed(np.asarray(st_t.state[0] > 0), ids):
+            if isinstance(e, PeerDiscovered):
+                discovered.add(e.peer)
+            elif isinstance(e, FingerprintChanged):
+                seen_fp = e.fingerprint
+    assert discovered == set(range(n))
+    want = mix_fingerprint({j: int(ids[j]) for j in range(n)})
+    assert seen_fp == want

@@ -116,7 +116,10 @@ class SimNetwork:
             n = self.capacity
             manual = np.full(n, -1, dtype=np.int32)
             for slot, q in self._manual.items():
-                if q:
+                # Queued pings of a stopped instance are held, not discarded:
+                # the kernel would mask them by aliveness anyway (D8).
+                inst = self._instances.get(slot)
+                if q and inst is not None and inst.is_running:
                     manual[slot] = q.popleft()
             # NB: copies are load-bearing — jnp.asarray may alias a NumPy
             # buffer on CPU, and the pending masks are cleared right below.
@@ -147,11 +150,18 @@ class SimNetwork:
         raise ConvergenceTimeout(f"no fingerprint agreement within {max_ticks} ticks")
 
     def _deliver_events(self) -> None:
-        member = np.asarray(self.state.state > 0)
+        from kaboodle_tpu.ops.hashing import membership_fingerprint
+
+        member_dev = self.state.state > 0
+        # One vectorized device pass for all rows' fingerprints instead of a
+        # per-instance Python hash loop (it matches mix_fingerprint bit-exactly,
+        # tests/test_oracle.py).
+        fps = np.asarray(membership_fingerprint(member_dev, self.state.identity))
+        member = np.asarray(member_dev)
         ids = np.asarray(self.state.identity)
         for slot, inst in self._instances.items():
             if inst.is_running:
-                inst._dispatch(inst._tap.feed(member[slot], ids))
+                inst._dispatch(inst._tap.feed(member[slot], ids, fingerprint=int(fps[slot])))
 
 
 class Kaboodle:
@@ -170,10 +180,7 @@ class Kaboodle:
         self._discover_subs: list[collections.deque] = []
         self._depart_subs: list[collections.deque] = []
         self._fp_subs: list[collections.deque] = []
-        network.state = dataclasses.replace(
-            network.state,
-            identity=network.state.identity.at[self._slot].set(_identity_word(identity)),
-        )
+        self.set_identity(identity)
 
     # ---- lifecycle (lib.rs:136-183) ---------------------------------------
 
@@ -286,6 +293,8 @@ class Kaboodle:
     def discover_next_peer(self, max_ticks: int = 64):
         """Tick the network until this instance discovers a peer; returns
         (peer, identity) or None after ``max_ticks`` (lib.rs:246-260)."""
+        if not self._running:
+            raise InvalidOperation("not running")
         q = self.discover_peers()
         try:
             for _ in range(max_ticks):

@@ -1,0 +1,57 @@
+"""Checkpoint / resume for simulator state.
+
+The reference has no checkpointing at all (SURVEY.md §5): membership state
+lives only in RAM and is rebuilt from the network after a restart. The
+simulator's whole mesh is a pytree of dense arrays (``MeshState``), so a
+checkpoint is a bit-exact array dump and resume is a load — determinism tests
+(same seed => identical trajectory) extend across a save/load boundary, which
+is asserted in tests/test_checkpoint.py.
+
+Format: a single ``.npz`` with one entry per ``MeshState`` field plus a format
+version. Every array round-trips exactly (including the PRNG key), so a
+resumed run is indistinguishable from an uninterrupted one. ``load`` can place
+the restored state directly onto a device mesh for the sharded runners.
+
+``MeshState`` is an ordinary registered pytree, so orbax-checkpoint works on
+it unmodified if async/multi-host checkpointing is ever needed; this module is
+the dependency-free synchronous path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.errors import KaboodleError
+from kaboodle_tpu.sim.state import MeshState
+
+_FORMAT_VERSION = 1
+
+
+def save(path, state: MeshState) -> None:
+    """Write ``state`` to ``path`` (.npz), host-fetching device arrays."""
+    arrays = {
+        f.name: np.asarray(getattr(state, f.name)) for f in dataclasses.fields(state)
+    }
+    np.savez(path, __version__=np.int32(_FORMAT_VERSION), **arrays)
+
+
+def load(path, mesh=None) -> MeshState:
+    """Read a checkpoint; with ``mesh`` set, place rows across its devices
+    (the layout kaboodle_tpu.parallel.shard_state would give a fresh state)."""
+    with np.load(path) as z:
+        version = int(z["__version__"])
+        if version != _FORMAT_VERSION:
+            raise KaboodleError(f"unsupported checkpoint version {version}")
+        fields = {f.name for f in dataclasses.fields(MeshState)}
+        missing = fields - set(z.files)
+        if missing:
+            raise KaboodleError(f"checkpoint missing fields: {sorted(missing)}")
+        state = MeshState(**{name: jnp.asarray(z[name]) for name in fields})
+    if mesh is not None:
+        from kaboodle_tpu.parallel import shard_state
+
+        state = shard_state(state, mesh)
+    return state

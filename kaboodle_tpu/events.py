@@ -69,13 +69,16 @@ class EventTap:
         self._identity: np.ndarray | None = None  # uint32 [N]
         self._announced_fp: int = 0  # Q10: empty-map fp 0 counts as announced
 
-    def feed(self, member, identities) -> list[Event]:
+    def feed(self, member, identities, fingerprint: int | None = None) -> list[Event]:
         """Diff against the previous snapshot; return this batch's events.
 
         Args:
           member: bool [N] — the observer's current membership row.
           identities: uint32 [N] — identity words (only entries where
             ``member`` is True are read).
+          fingerprint: precomputed row fingerprint (must equal
+            ``mix_fingerprint`` of the row — the kernel/ops value qualifies);
+            computed host-side when omitted.
         """
         member = np.asarray(member, dtype=bool)
         identities = np.asarray(identities, dtype=np.uint32)
@@ -98,7 +101,11 @@ class EventTap:
         for p in removed:
             events.append(PeerDeparted(int(p)))
 
-        fp = mix_fingerprint({int(p): int(identities[p]) for p in np.flatnonzero(member)})
+        if fingerprint is None:
+            fingerprint = mix_fingerprint(
+                {int(p): int(identities[p]) for p in np.flatnonzero(member)}
+            )
+        fp = fingerprint
         if fp != self._announced_fp and member.any():
             events.append(FingerprintChanged(fp))
             self._announced_fp = fp

@@ -91,7 +91,7 @@ def test_discovery_stream_and_next_peer():
     assert got is not None and got[0] == 1
 
 
-def test_restart_rejoins_with_reset(monkeypatch=None):
+def test_restart_rejoins_with_reset():
     net, nodes = _demo_mesh()
     net.tick_until_converged(max_ticks=16)
     nodes[0].stop()

@@ -83,9 +83,6 @@ def test_tap_over_simulated_run():
     """Feeding per-tick rows of a real run: observer 0 discovers the whole
     mesh; the last announced fingerprint matches the final converged state."""
     n = 16
-    st = init_state(n, seed=4)
-    final, _ = simulate(st, idle_inputs(n, ticks=6), SwimConfig(), faulty=False)
-    # Re-run tick by tick to snapshot rows (scan output only has the final).
     tap = EventTap()
     discovered = set()
     st_t = init_state(n, seed=4)

@@ -42,6 +42,8 @@ def load(path, mesh=None) -> MeshState:
     """Read a checkpoint; with ``mesh`` set, place rows across its devices
     (the layout kaboodle_tpu.parallel.shard_state would give a fresh state)."""
     with np.load(path) as z:
+        if "__version__" not in z.files:
+            raise KaboodleError("not a kaboodle checkpoint (no version entry)")
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
             raise KaboodleError(f"unsupported checkpoint version {version}")

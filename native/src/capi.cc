@@ -1,0 +1,204 @@
+// C API for the native engine — the ctypes boundary consumed by
+// kaboodle_tpu.transport.native. Strings in, JSON (malloc'd, kb_free) out;
+// identities cross as hex to stay encoding-agnostic.
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+
+#include "engine.h"
+
+using namespace kaboodle;
+
+namespace {
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+std::string hex(const Bytes& b) {
+  static const char* d = "0123456789abcdef";
+  std::string s;
+  for (uint8_t c : b) {
+    s.push_back(d[c >> 4]);
+    s.push_back(d[c & 15]);
+  }
+  return s;
+}
+
+const char* state_name(PeerStateKind k) {
+  switch (k) {
+    case PeerStateKind::Known:
+      return "Known";
+    case PeerStateKind::WaitingForPing:
+      return "WaitingForPing";
+    default:
+      return "WaitingForIndirectPing";
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct kb_engine {
+  Engine* impl;
+};
+
+kb_engine* kb_create(const char* bind_ip, const char* broadcast_ip,
+                     uint16_t broadcast_port, unsigned iface_index,
+                     const uint8_t* identity, size_t identity_len, uint32_t period_ms,
+                     uint32_t ping_timeout_ms, uint32_t share_age_ms,
+                     uint32_t rebroadcast_ms, uint64_t rng_seed) {
+  auto bip = NetAddr::parse(std::string(bind_ip) + ":0");
+  auto mip = NetAddr::parse(std::string(broadcast_ip).find(':') != std::string::npos
+                                ? "[" + std::string(broadcast_ip) + "]:0"
+                                : std::string(broadcast_ip) + ":0");
+  if (!bip || !mip) return nullptr;
+  EngineConfig cfg;
+  cfg.bind_ip = *bip;
+  cfg.broadcast_ip = *mip;
+  cfg.broadcast_port = broadcast_port;
+  cfg.iface_index = iface_index;
+  cfg.identity.assign(identity, identity + identity_len);
+  cfg.period_ms = period_ms;
+  cfg.ping_timeout_ms = ping_timeout_ms;
+  cfg.share_age_ms = share_age_ms;
+  cfg.rebroadcast_ms = rebroadcast_ms;
+  cfg.rng_seed = rng_seed;
+  return new kb_engine{new Engine(std::move(cfg))};
+}
+
+int kb_start(kb_engine* h) {
+  return h && h->impl->start() ? 0 : -1;
+}
+
+int kb_stop(kb_engine* h) {
+  if (!h) return -1;
+  h->impl->stop();
+  return 0;
+}
+
+void kb_destroy(kb_engine* h) {
+  if (h) {
+    delete h->impl;
+    delete h;
+  }
+}
+
+int kb_is_running(kb_engine* h) {
+  return h && h->impl->running() ? 1 : 0;
+}
+
+char* kb_self_addr(kb_engine* h) {
+  return dup_string(h->impl->self_addr().to_string());
+}
+
+uint32_t kb_fingerprint(kb_engine* h) {
+  return h->impl->fingerprint_now();
+}
+
+char* kb_peers_json(kb_engine* h) {
+  std::ostringstream os;
+  os << "[";
+  bool first = true;
+  for (const auto& [addr, e] : h->impl->peers_snapshot()) {
+    if (!first) os << ",";
+    first = false;
+    os << "{\"addr\":\"" << addr.to_string() << "\",\"identity_hex\":\""
+       << hex(e.identity) << "\",\"state\":\"" << state_name(e.state)
+       << "\",\"latency_ms\":" << e.latency_ms << "}";
+  }
+  os << "]";
+  return dup_string(os.str());
+}
+
+char* kb_events_json(kb_engine* h) {
+  std::ostringstream os;
+  os << "[";
+  bool first = true;
+  for (const auto& ev : h->impl->drain_events()) {
+    if (!first) os << ",";
+    first = false;
+    switch (ev.kind) {
+      case EngineEvent::Discovered:
+        os << "{\"type\":\"discovered\",\"addr\":\"" << ev.addr.to_string()
+           << "\",\"identity_hex\":\"" << hex(ev.identity) << "\"}";
+        break;
+      case EngineEvent::Departed:
+        os << "{\"type\":\"departed\",\"addr\":\"" << ev.addr.to_string() << "\"}";
+        break;
+      case EngineEvent::FingerprintChanged:
+        os << "{\"type\":\"fingerprint\",\"value\":" << ev.fingerprint << "}";
+        break;
+    }
+  }
+  os << "]";
+  return dup_string(os.str());
+}
+
+int kb_ping_addr(kb_engine* h, const char* addr) {
+  auto a = NetAddr::parse(addr);
+  if (!a) return -1;
+  h->impl->ping_addr(*a);
+  return 0;
+}
+
+int kb_set_identity(kb_engine* h, const uint8_t* identity, size_t len) {
+  if (!h) return -1;
+  h->impl->set_identity(Bytes(identity, identity + len));
+  return 0;
+}
+
+char* kb_probe(const char* bind_ip, const char* broadcast_ip, uint16_t port,
+               unsigned iface_index, uint32_t start_ms, double multiplier,
+               uint32_t cap_ms, uint32_t total_timeout_ms) {
+  auto bip = NetAddr::parse(std::string(bind_ip) + ":0");
+  auto mip = NetAddr::parse(std::string(broadcast_ip).find(':') != std::string::npos
+                                ? "[" + std::string(broadcast_ip) + "]:0"
+                                : std::string(broadcast_ip) + ":0");
+  if (!bip || !mip) return dup_string("");
+  return dup_string(probe_mesh(*bip, *mip, port, iface_index, start_ms, multiplier,
+                               cap_ms, total_timeout_ms));
+}
+
+char* kb_best_interface() {
+  return dup_string(best_available_interface());
+}
+
+char* kb_list_interfaces() {
+  return dup_string(list_interfaces());
+}
+
+void kb_free(char* p) {
+  std::free(p);
+}
+
+// --- codec test hooks: decode + re-encode, for cross-language golden tests.
+
+long kb_codec_roundtrip_envelope(const uint8_t* in, size_t len, uint8_t* out,
+                                 size_t cap) {
+  auto e = decode_envelope(in, len);
+  if (!e) return -1;
+  Bytes b = encode_envelope(*e);
+  if (b.size() > cap) return -1;
+  std::memcpy(out, b.data(), b.size());
+  return long(b.size());
+}
+
+long kb_codec_roundtrip_broadcast(const uint8_t* in, size_t len, uint8_t* out,
+                                  size_t cap) {
+  auto b = decode_broadcast(in, len);
+  if (!b) return -1;
+  Bytes enc = encode_broadcast(*b);
+  if (enc.size() > cap) return -1;
+  std::memcpy(out, enc.data(), enc.size());
+  return long(enc.size());
+}
+
+uint32_t kb_crc32(const uint8_t* data, size_t len) {
+  return crc32(data, len, 0);
+}
+
+}  // extern "C"

@@ -1,0 +1,604 @@
+#include "engine.h"
+
+#include <poll.h>
+
+#include <algorithm>
+#include <cstdio>
+
+namespace kaboodle {
+
+namespace {
+std::string hex(const Bytes& b) {
+  static const char* d = "0123456789abcdef";
+  std::string s;
+  s.reserve(b.size() * 2);
+  for (uint8_t c : b) {
+    s.push_back(d[c >> 4]);
+    s.push_back(d[c & 15]);
+  }
+  return s;
+}
+}  // namespace
+
+Engine::Engine(EngineConfig cfg) : cfg_(std::move(cfg)) {
+  uint64_t seed = cfg_.rng_seed ? cfg_.rng_seed : std::random_device{}();
+  rng_.seed(seed);
+}
+
+Engine::~Engine() {
+  stop();
+}
+
+bool Engine::start() {
+  if (running_) return false;
+  auto us = bind_unicast(cfg_.bind_ip);
+  if (!us) return false;
+  sock_ = std::move(*us);
+  auto la = sock_.local_addr();
+  if (!la) return false;
+  self_addr_ = *la;
+
+  auto bp = open_broadcast(cfg_.broadcast_ip, cfg_.broadcast_port, cfg_.iface_index);
+  if (!bp) return false;
+  bcast_ = std::move(*bp);
+
+  {
+    // Self goes into the map as Known(now) (kaboodle.rs:144-152).
+    std::lock_guard<std::mutex> lk(mu_);
+    PeerEntry self;
+    self.identity = cfg_.identity;
+    self.state = PeerStateKind::Known;
+    self.when = Clock::now();
+    bool is_new = peers_.find(self_addr_) == peers_.end();
+    peers_[self_addr_] = std::move(self);
+    if (is_new) {
+      EngineEvent ev;
+      ev.kind = EngineEvent::Discovered;
+      ev.addr = self_addr_;
+      ev.identity = cfg_.identity;
+      events_.push_back(std::move(ev));
+    }
+  }
+  note_fingerprint_maybe_changed();
+
+  cancel_ = false;
+  running_ = true;
+  last_broadcast_.reset();
+  thread_ = std::thread([this] { run_loop(); });
+  return true;
+}
+
+void Engine::stop() {
+  if (!running_) return;
+  cancel_ = true;
+  if (thread_.joinable()) thread_.join();
+  // Silent leave (Q8): no Failed/departure announcement. Map is kept minus
+  // self (lib.rs:167-170).
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    peers_.erase(self_addr_);
+  }
+  sock_ = UdpSock();
+  bcast_ = BroadcastPair();
+  running_ = false;
+}
+
+void Engine::run_loop() {
+  while (!cancel_) tick();
+}
+
+// One protocol period (kaboodle.rs:746-779): the active half then the
+// reactive half for the remainder of the period.
+void Engine::tick() {
+  auto start = Clock::now();
+  maybe_broadcast_join(start);
+  handle_suspected_peers(start);
+  ping_random_peer(start);
+  drain_manual_pings();
+  auto deadline = start + std::chrono::milliseconds(cfg_.period_ms);
+  auto min_wait = Clock::now() + std::chrono::milliseconds(10);
+  pump_sockets_until(std::max(deadline, min_wait));
+  note_fingerprint_maybe_changed();
+}
+
+void Engine::maybe_broadcast_join(Clock::time_point now) {
+  // First call always broadcasts; later only while lonely and stale
+  // (kaboodle.rs:228-251).
+  if (last_broadcast_) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (now - *last_broadcast_ < std::chrono::milliseconds(cfg_.rebroadcast_ms) ||
+        peers_.size() > 1)
+      return;
+  }
+  last_broadcast_ = now;
+  Broadcast b;
+  b.kind = BroadcastKind::Join;
+  b.addr = self_addr_;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    b.identity = cfg_.identity;
+  }
+  broadcast(b);
+}
+
+void Engine::handle_suspected_peers(Clock::time_point now) {
+  // Escalate stale WaitingForPing to indirect pings via k proxies; remove
+  // stale WaitingForIndirectPing (kaboodle.rs:558-653).
+  auto timeout = std::chrono::milliseconds(cfg_.ping_timeout_ms);
+  std::vector<NetAddr> removed, escalated;
+  std::vector<std::pair<NetAddr, std::vector<NetAddr>>> ping_reqs;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<NetAddr> known_others;
+    for (const auto& [addr, e] : peers_)
+      if (!(addr == self_addr_) && e.state == PeerStateKind::Known)
+        known_others.push_back(addr);
+
+    for (const auto& [addr, e] : peers_) {
+      if (e.state == PeerStateKind::WaitingForPing && now - e.when >= timeout) {
+        if (known_others.empty()) {
+          removed.push_back(addr);  // no proxies -> drop now (:599-605)
+          continue;
+        }
+        std::vector<NetAddr> proxies = known_others;
+        std::shuffle(proxies.begin(), proxies.end(), rng_);
+        if (proxies.size() > cfg_.indirect_peers) proxies.resize(cfg_.indirect_peers);
+        ping_reqs.emplace_back(addr, std::move(proxies));
+        escalated.push_back(addr);
+      } else if (e.state == PeerStateKind::WaitingForIndirectPing &&
+                 now - e.when >= timeout) {
+        removed.push_back(addr);
+      }
+    }
+    for (const auto& addr : escalated) {
+      auto it = peers_.find(addr);
+      if (it != peers_.end()) {
+        it->second.state = PeerStateKind::WaitingForIndirectPing;
+        it->second.when = now;
+      }
+    }
+  }
+  for (const auto& [suspect, proxies] : ping_reqs) {
+    Message m;
+    m.kind = MsgKind::PingRequest;
+    m.peer = suspect;
+    for (const auto& p : proxies) send_msg(p, m);
+  }
+  for (const auto& addr : removed) {
+    remove_peer(addr);
+    Broadcast b;
+    b.kind = BroadcastKind::Failed;
+    b.addr = addr;
+    broadcast(b);  // inert at receivers in practice (Q3)
+  }
+}
+
+void Engine::ping_random_peer(Clock::time_point now) {
+  // Random choice among the oldest candidate_peers Known peers
+  // (kaboodle.rs:655-703).
+  NetAddr target;
+  bool have = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::pair<Clock::time_point, NetAddr>> cands;
+    for (const auto& [addr, e] : peers_)
+      if (!(addr == self_addr_) && e.state == PeerStateKind::Known)
+        cands.emplace_back(e.when, addr);
+    if (cands.empty()) return;
+    std::sort(cands.begin(), cands.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    size_t pool = std::min<size_t>(cfg_.candidate_peers, cands.size());
+    size_t pick = std::uniform_int_distribution<size_t>(0, pool - 1)(rng_);
+    target = cands[pick].second;
+    auto it = peers_.find(target);
+    it->second.state = PeerStateKind::WaitingForPing;
+    it->second.when = now;
+    have = true;
+  }
+  if (have) {
+    Message m;
+    m.kind = MsgKind::Ping;
+    send_msg(target, m);
+  }
+}
+
+void Engine::drain_manual_pings() {
+  std::deque<NetAddr> q;
+  {
+    std::lock_guard<std::mutex> lk(manual_mu_);
+    q.swap(manual_pings_);
+  }
+  Message m;
+  m.kind = MsgKind::Ping;
+  for (const auto& a : q) send_msg(a, m);
+}
+
+void Engine::pump_sockets_until(Clock::time_point deadline) {
+  std::vector<uint8_t> buf(cfg_.buffer_size, 0);
+  while (!cancel_) {
+    auto now = Clock::now();
+    if (now >= deadline) return;
+    pollfd fds[2] = {{bcast_.in.fd, POLLIN, 0}, {sock_.fd, POLLIN, 0}};
+    int wait_ms = int(
+        std::chrono::duration_cast<std::chrono::milliseconds>(deadline - now).count());
+    int rv = ::poll(fds, 2, std::min(wait_ms, 50));
+    if (rv <= 0) continue;
+
+    NetAddr sender;
+    if (fds[0].revents & POLLIN) {
+      long n;
+      while ((n = bcast_.in.recv_from(buf.data(), buf.size(), &sender)) > 0) {
+        // Q2: decode from the zero-padded full buffer, prefix-tolerant.
+        std::fill(buf.begin() + n, buf.end(), 0);
+        if (auto b = decode_broadcast(buf.data(), buf.size())) handle_broadcast(*b, sender);
+      }
+    }
+    if (fds[1].revents & POLLIN) {
+      long n;
+      while ((n = sock_.recv_from(buf.data(), buf.size(), &sender)) > 0) {
+        std::fill(buf.begin() + n, buf.end(), 0);
+        if (auto e = decode_envelope(buf.data(), buf.size())) handle_message(*e, sender);
+      }
+    }
+  }
+}
+
+void Engine::handle_broadcast(const Broadcast& b, const NetAddr& sender) {
+  switch (b.kind) {
+    case BroadcastKind::Failed: {
+      if (b.addr == self_addr_) return;
+      // Q3: removal requires the *broadcast source address* to be a known
+      // member — which it never is (the source is the broadcast socket), so
+      // this is faithfully inert.
+      std::unique_lock<std::mutex> lk(mu_);
+      if (peers_.count(sender)) {
+        lk.unlock();
+        remove_peer(b.addr);
+      }
+      break;
+    }
+    case BroadcastKind::Join: {
+      if (b.addr == self_addr_) return;
+      bool is_new;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = peers_.find(b.addr);
+        is_new = it == peers_.end();
+        PeerEntry e;
+        e.identity = b.identity;
+        e.state = PeerStateKind::Known;
+        e.when = Clock::now();
+        e.latency_ms = is_new ? -1 : it->second.latency_ms;
+        peers_[b.addr] = std::move(e);
+        if (is_new) {
+          EngineEvent ev;
+          ev.kind = EngineEvent::Discovered;
+          ev.addr = b.addr;
+          ev.identity = b.identity;
+          events_.push_back(std::move(ev));
+        }
+      }
+      if (is_new) maybe_send_known_peers(b.addr);
+      break;
+    }
+    case BroadcastKind::Probe: {
+      if (should_respond_to_broadcast()) {
+        Bytes ident;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          ident = cfg_.identity;
+        }
+        Bytes out = encode_probe_response(ident);
+        sock_.send_to(out.data(), out.size(), b.addr);
+      }
+      break;
+    }
+  }
+}
+
+void Engine::mark_sender_known(const NetAddr& sender, const Bytes& identity) {
+  // Q1 (kaboodle.rs:408-415): any inbound datagram resurrects its sender,
+  // updating the latency EWMA from a pending ping's send time.
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  auto it = peers_.find(sender);
+  PeerEntry e;
+  e.identity = identity;
+  e.state = PeerStateKind::Known;
+  e.when = now;
+  bool is_new = it == peers_.end();
+  if (!is_new) {
+    e.latency_ms = it->second.latency_ms;
+    if (it->second.state != PeerStateKind::Known) {
+      double sample =
+          std::chrono::duration_cast<std::chrono::duration<double, std::milli>>(
+              now - it->second.when)
+              .count();
+      // 80% weight to the newest sample (kaboodle.rs:789-817).
+      e.latency_ms = it->second.latency_ms < 0 ? sample
+                                               : sample * 0.8 + it->second.latency_ms * 0.2;
+    }
+    if (it->second.identity != identity) {
+      EngineEvent ev;
+      ev.kind = EngineEvent::Discovered;
+      ev.addr = sender;
+      ev.identity = identity;
+      events_.push_back(std::move(ev));
+    }
+  } else {
+    EngineEvent ev;
+    ev.kind = EngineEvent::Discovered;
+    ev.addr = sender;
+    ev.identity = identity;
+    events_.push_back(std::move(ev));
+  }
+  peers_[sender] = std::move(e);
+}
+
+void Engine::handle_message(const Envelope& env, const NetAddr& sender) {
+  mark_sender_known(sender, env.identity);
+
+  switch (env.msg.kind) {
+    case MsgKind::Ack: {
+      // Forward to curious observers (indirect-ping relay), then maybe sync
+      // (kaboodle.rs:418-447).
+      std::vector<NetAddr> observers;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = curious_.find(env.msg.peer);
+        if (it != curious_.end()) {
+          observers = std::move(it->second);
+          curious_.erase(it);
+        }
+      }
+      for (const auto& o : observers) send_msg(o, env.msg);
+      maybe_sync_known_peers(env.msg.peer, env.msg.fingerprint, env.msg.num_peers);
+      break;
+    }
+    case MsgKind::KnownPeers: {
+      // Gossip inserts are back-dated by share_age so they are never
+      // re-shared before direct contact (Q6, kaboodle.rs:448-472).
+      std::lock_guard<std::mutex> lk(mu_);
+      auto backdated = Clock::now() - std::chrono::milliseconds(cfg_.share_age_ms);
+      for (const auto& [addr, ident] : env.msg.known_peers) {
+        if (peers_.count(addr)) continue;
+        PeerEntry e;
+        e.identity = ident;
+        e.state = PeerStateKind::Known;
+        e.when = backdated;
+        peers_[addr] = std::move(e);
+        EngineEvent ev;
+        ev.kind = EngineEvent::Discovered;
+        ev.addr = addr;
+        ev.identity = ident;
+        events_.push_back(std::move(ev));
+      }
+      break;
+    }
+    case MsgKind::KnownPeersRequest: {
+      // Reply with Known peers heard within share_age, excluding self and
+      // the requester; then maybe sync back (kaboodle.rs:473-512).
+      Message reply;
+      reply.kind = MsgKind::KnownPeers;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto now = Clock::now();
+        for (const auto& [addr, e] : peers_) {
+          if (addr == self_addr_ || addr == sender) continue;
+          if (e.state != PeerStateKind::Known) continue;
+          if (now - e.when >= std::chrono::milliseconds(cfg_.share_age_ms)) continue;
+          reply.known_peers.emplace(addr, e.identity);
+        }
+      }
+      send_msg(sender, reply);
+      maybe_sync_known_peers(sender, env.msg.fingerprint, env.msg.num_peers);
+      break;
+    }
+    case MsgKind::Ping: {
+      Message ack;
+      ack.kind = MsgKind::Ack;
+      ack.peer = self_addr_;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        std::map<NetAddr, Bytes> m;
+        for (const auto& [a, e] : peers_) m.emplace(a, e.identity);
+        ack.fingerprint = fingerprint(m);
+        ack.num_peers = uint32_t(peers_.size());
+      }
+      send_msg(sender, ack);
+      break;
+    }
+    case MsgKind::PingRequest: {
+      // Record the curious sender, ping the suspect ourselves
+      // (kaboodle.rs:533-545).
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto& obs = curious_[env.msg.peer];
+        if (std::find(obs.begin(), obs.end(), sender) == obs.end())
+          obs.push_back(sender);
+      }
+      Message ping;
+      ping.kind = MsgKind::Ping;
+      send_msg(env.msg.peer, ping);
+      break;
+    }
+  }
+}
+
+void Engine::maybe_sync_known_peers(const NetAddr& peer, uint32_t their_fp,
+                                    uint32_t their_n) {
+  // Anti-entropy pull: request their map iff fingerprints differ and ours is
+  // not strictly bigger (kaboodle.rs:707-740).
+  uint32_t our_fp, our_n;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::map<NetAddr, Bytes> m;
+    for (const auto& [a, e] : peers_) m.emplace(a, e.identity);
+    our_fp = fingerprint(m);
+    our_n = uint32_t(peers_.size());
+  }
+  if (our_fp == their_fp || our_n > their_n) return;
+  Message m;
+  m.kind = MsgKind::KnownPeersRequest;
+  m.fingerprint = our_fp;
+  m.num_peers = our_n;
+  send_msg(peer, m);
+}
+
+bool Engine::should_respond_to_broadcast() {
+  // max(1, 100 - n^2)% with n = |peers| - 2 (kaboodle.rs:333-354).
+  int64_t n;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    n = int64_t(peers_.size()) - 2;
+  }
+  if (n <= 0) return true;
+  double pct = double(std::max<int64_t>(1, 100 - n * n)) / 100.0;
+  return std::uniform_real_distribution<double>(0.0, 1.0)(rng_) < pct;
+}
+
+void Engine::maybe_send_known_peers(const NetAddr& addr) {
+  if (!should_respond_to_broadcast()) return;
+  // Q5: the join-response shares the whole map (self included, no age
+  // filter), trimmed at random until it fits the receive buffer
+  // (kaboodle.rs:356-392).
+  Message m;
+  m.kind = MsgKind::KnownPeers;
+  Bytes ident;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& [a, e] : peers_) m.known_peers.emplace(a, e.identity);
+    ident = cfg_.identity;
+  }
+  if (m.known_peers.empty()) return;
+  Envelope env{ident, m};
+  Bytes out = encode_envelope(env);
+  while (out.size() >= cfg_.buffer_size && !env.msg.known_peers.empty()) {
+    auto it = env.msg.known_peers.begin();
+    std::advance(it, std::uniform_int_distribution<size_t>(
+                         0, env.msg.known_peers.size() - 1)(rng_));
+    env.msg.known_peers.erase(it);
+    out = encode_envelope(env);
+  }
+  sock_.send_to(out.data(), out.size(), addr);
+}
+
+void Engine::send_msg(const NetAddr& to, const Message& m) {
+  Envelope env;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    env.identity = cfg_.identity;
+  }
+  env.msg = m;
+  Bytes out = encode_envelope(env);
+  if (!sock_.send_to(out.data(), out.size(), to) && m.kind == MsgKind::Ping) {
+    // Q7: a failed ping send removes the target immediately
+    // (kaboodle.rs:694-702).
+    remove_peer(to);
+  }
+}
+
+void Engine::broadcast(const Broadcast& b) {
+  Bytes out = encode_broadcast(b);
+  bcast_.out.send_to(out.data(), out.size(), bcast_.dest);
+}
+
+void Engine::remove_peer(const NetAddr& addr) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (peers_.erase(addr)) {
+    curious_.erase(addr);
+    EngineEvent ev;
+    ev.kind = EngineEvent::Departed;
+    ev.addr = addr;
+    events_.push_back(std::move(ev));
+  }
+}
+
+void Engine::note_fingerprint_maybe_changed() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::map<NetAddr, Bytes> m;
+  for (const auto& [a, e] : peers_) m.emplace(a, e.identity);
+  uint32_t fp = fingerprint(m);
+  // Q10: the empty-map fingerprint (0) is never announced.
+  if (fp != announced_fp_ && !m.empty()) {
+    announced_fp_ = fp;
+    EngineEvent ev;
+    ev.kind = EngineEvent::FingerprintChanged;
+    ev.fingerprint = fp;
+    events_.push_back(std::move(ev));
+  }
+}
+
+uint32_t Engine::fingerprint_now() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::map<NetAddr, Bytes> m;
+  for (const auto& [a, e] : peers_) m.emplace(a, e.identity);
+  return fingerprint(m);
+}
+
+std::map<NetAddr, PeerEntry> Engine::peers_snapshot() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return peers_;
+}
+
+std::vector<EngineEvent> Engine::drain_events() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<EngineEvent> out(events_.begin(), events_.end());
+  events_.clear();
+  return out;
+}
+
+void Engine::ping_addr(const NetAddr& target) {
+  std::lock_guard<std::mutex> lk(manual_mu_);
+  manual_pings_.push_back(target);
+}
+
+void Engine::set_identity(Bytes identity) {
+  std::lock_guard<std::mutex> lk(mu_);
+  cfg_.identity = std::move(identity);
+  auto it = peers_.find(self_addr_);
+  if (it != peers_.end()) it->second.identity = cfg_.identity;
+}
+
+std::string probe_mesh(const NetAddr& bind_ip, const NetAddr& bcast_ip, uint16_t port,
+                       unsigned iface_index, uint32_t start_ms, double multiplier,
+                       uint32_t cap_ms, uint32_t total_timeout_ms) {
+  auto us = bind_unicast(bind_ip);
+  if (!us) return "";
+  auto la = us->local_addr();
+  if (!la) return "";
+  auto bp = open_broadcast(bcast_ip, port, iface_index);
+  if (!bp) return "";
+
+  Broadcast probe;
+  probe.kind = BroadcastKind::Probe;
+  probe.addr = *la;
+  Bytes out = encode_broadcast(probe);
+
+  using Clock = std::chrono::steady_clock;
+  auto overall = Clock::now() + std::chrono::milliseconds(total_timeout_ms);
+  double interval = start_ms;
+  std::vector<uint8_t> buf(1024, 0);  // discovery.rs:16
+
+  while (Clock::now() < overall) {
+    bp->out.send_to(out.data(), out.size(), bp->dest);
+    auto wait_until = Clock::now() + std::chrono::milliseconds(uint32_t(interval));
+    while (Clock::now() < wait_until && Clock::now() < overall) {
+      pollfd fd{us->fd, POLLIN, 0};
+      ::poll(&fd, 1, 20);
+      NetAddr sender;
+      long n = us->recv_from(buf.data(), buf.size(), &sender);
+      if (n > 0) {
+        std::fill(buf.begin() + n, buf.end(), 0);
+        // Q4: the reply is a raw ProbeResponse but is parsed as an envelope —
+        // works because the zero tail decodes as SwimMessage::Ping (Q2).
+        if (auto env = decode_envelope(buf.data(), buf.size()))
+          return sender.to_string() + "|" + hex(env->identity);
+      }
+    }
+    interval = std::min(double(cap_ms), interval * multiplier);
+  }
+  return "";
+}
+
+}  // namespace kaboodle

@@ -1,0 +1,129 @@
+// The real-network SWIM engine: reference protocol semantics over real UDP.
+//
+// This is the native runtime for real-network interop (the reference's whole
+// product, kaboodle.rs). Design differs from the reference (no async runtime;
+// one poll()-driven thread per instance; codec split out into wire.h) but the
+// protocol behavior matches the call stacks in SURVEY.md §3.2-3.4, including
+// the load-bearing quirks: any inbound datagram marks its sender Known (Q1),
+// Failed-broadcast removal requires a known sender (Q3, making it inert on
+// real sockets), join shares are unfiltered and trimmed to the receive buffer
+// (Q5), gossip-learned peers are back-dated so they never re-gossip (Q6), a
+// failed ping send removes the target immediately (Q7), and stop() leaves
+// silently (Q8).
+//
+// All timing constants are injectable so tests can run at millisecond scale;
+// defaults match the reference (kaboodle.rs:38-65).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+
+#include "transport.h"
+#include "wire.h"
+
+namespace kaboodle {
+
+struct EngineConfig {
+  NetAddr bind_ip{};     // unicast bind address (port ignored; ephemeral)
+  NetAddr broadcast_ip;  // v4 broadcast addr or v6 multicast group
+  uint16_t broadcast_port = 7475;
+  unsigned iface_index = 0;  // for v6 multicast
+  Bytes identity;
+  uint32_t period_ms = 1000;         // PROTOCOL_PERIOD (kaboodle.rs:38)
+  uint32_t ping_timeout_ms = 2000;   // PING_TIMEOUT (kaboodle.rs:62)
+  uint32_t share_age_ms = 10000;     // MAX_PEER_SHARE_AGE (kaboodle.rs:49)
+  uint32_t rebroadcast_ms = 10000;   // REBROADCAST_INTERVAL (kaboodle.rs:65)
+  uint32_t buffer_size = 10240;      // INCOMING_BUFFER_SIZE (kaboodle.rs:43)
+  uint32_t indirect_peers = 3;       // NUM_INDIRECT_PING_PEERS
+  uint32_t candidate_peers = 5;      // NUM_CANDIDATE_TARGET_PEERS
+  uint64_t rng_seed = 0;             // 0 = seed from std::random_device
+};
+
+enum class PeerStateKind : uint8_t { Known = 0, WaitingForPing = 1, WaitingForIndirectPing = 2 };
+
+struct PeerEntry {
+  Bytes identity;
+  PeerStateKind state = PeerStateKind::Known;
+  std::chrono::steady_clock::time_point when{};  // last-heard / sent-at
+  double latency_ms = -1;                        // EWMA (kaboodle.rs:789-817); <0 none
+};
+
+struct EngineEvent {
+  enum Kind { Discovered, Departed, FingerprintChanged } kind;
+  NetAddr addr{};       // Discovered/Departed
+  Bytes identity;       // Discovered
+  uint32_t fingerprint = 0;  // FingerprintChanged
+};
+
+class Engine {
+ public:
+  explicit Engine(EngineConfig cfg);
+  ~Engine();
+
+  bool start();  // bind sockets, spawn the protocol thread
+  void stop();   // silent leave (Q8): cancel thread, close sockets, keep map
+
+  bool running() const { return running_; }
+  NetAddr self_addr() const { return self_addr_; }
+
+  uint32_t fingerprint_now();
+  std::map<NetAddr, PeerEntry> peers_snapshot();
+  std::vector<EngineEvent> drain_events();
+  void ping_addr(const NetAddr& target);  // manual ping (lib.rs:268-297)
+  void set_identity(Bytes identity);
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  void run_loop();
+  void tick();
+  void maybe_broadcast_join(Clock::time_point now);
+  void handle_suspected_peers(Clock::time_point now);
+  void ping_random_peer(Clock::time_point now);
+  void drain_manual_pings();
+  void pump_sockets_until(Clock::time_point deadline);
+  void handle_broadcast(const Broadcast& b, const NetAddr& sender);
+  void handle_message(const Envelope& env, const NetAddr& sender);
+  void mark_sender_known(const NetAddr& sender, const Bytes& identity);  // Q1
+  void maybe_sync_known_peers(const NetAddr& peer, uint32_t their_fp, uint32_t their_n);
+  bool should_respond_to_broadcast();  // max(1, 100-n^2)% (kaboodle.rs:333-354)
+  void maybe_send_known_peers(const NetAddr& addr);  // Q5 + 10KiB trim
+  void send_msg(const NetAddr& to, const Message& m);
+  void broadcast(const Broadcast& b);
+  void insert_or_update(const NetAddr& addr, PeerEntry entry);
+  void remove_peer(const NetAddr& addr);
+  void note_fingerprint_maybe_changed();
+
+  EngineConfig cfg_;
+  UdpSock sock_;
+  BroadcastPair bcast_;
+  NetAddr self_addr_{};
+  std::mt19937_64 rng_;
+
+  std::mutex mu_;  // guards peers_, curious_, events_, identity_
+  std::map<NetAddr, PeerEntry> peers_;
+  std::map<NetAddr, std::vector<NetAddr>> curious_;
+  std::deque<EngineEvent> events_;
+  uint32_t announced_fp_ = 0;
+
+  std::thread thread_;
+  std::atomic<bool> running_{false};
+  std::atomic<bool> cancel_{false};
+  std::mutex manual_mu_;
+  std::deque<NetAddr> manual_pings_;
+  std::optional<Clock::time_point> last_broadcast_;
+};
+
+// discover_mesh_member (discovery.rs:30-89): broadcast Probe with exponential
+// backoff until a unicast reply arrives; returns "addr|identity_hex", or ""
+// on timeout.
+std::string probe_mesh(const NetAddr& bind_ip, const NetAddr& bcast_ip, uint16_t port,
+                       unsigned iface_index, uint32_t start_ms, double multiplier,
+                       uint32_t cap_ms, uint32_t total_timeout_ms);
+
+}  // namespace kaboodle

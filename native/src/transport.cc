@@ -1,0 +1,236 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace kaboodle {
+
+namespace {
+
+socklen_t to_sockaddr(const NetAddr& a, sockaddr_storage* ss) {
+  std::memset(ss, 0, sizeof(*ss));
+  if (a.v6) {
+    auto* s6 = reinterpret_cast<sockaddr_in6*>(ss);
+    s6->sin6_family = AF_INET6;
+    s6->sin6_port = htons(a.port);
+    std::memcpy(&s6->sin6_addr, a.ip.data(), 16);
+    return sizeof(sockaddr_in6);
+  }
+  auto* s4 = reinterpret_cast<sockaddr_in*>(ss);
+  s4->sin_family = AF_INET;
+  s4->sin_port = htons(a.port);
+  std::memcpy(&s4->sin_addr, a.ip.data(), 4);
+  return sizeof(sockaddr_in);
+}
+
+NetAddr from_sockaddr(const sockaddr_storage& ss) {
+  NetAddr a;
+  if (ss.ss_family == AF_INET6) {
+    const auto* s6 = reinterpret_cast<const sockaddr_in6*>(&ss);
+    a.v6 = true;
+    std::memcpy(a.ip.data(), &s6->sin6_addr, 16);
+    a.port = ntohs(s6->sin6_port);
+  } else {
+    const auto* s4 = reinterpret_cast<const sockaddr_in*>(&ss);
+    a.v6 = false;
+    std::memcpy(a.ip.data(), &s4->sin_addr, 4);
+    a.port = ntohs(s4->sin_port);
+  }
+  return a;
+}
+
+bool set_nonblocking_reuse(int fd, bool reuse) {
+  int one = 1;
+  if (reuse) {
+    if (setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one)) < 0) return false;
+    if (setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) < 0) return false;
+  }
+  int flags = fcntl(fd, F_GETFL);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+}  // namespace
+
+UdpSock& UdpSock::operator=(UdpSock&& o) noexcept {
+  if (this != &o) {
+    if (fd >= 0) close(fd);
+    fd = o.fd;
+    o.fd = -1;
+  }
+  return *this;
+}
+
+UdpSock::~UdpSock() {
+  if (fd >= 0) close(fd);
+}
+
+long UdpSock::recv_from(uint8_t* buf, size_t cap, NetAddr* sender) const {
+  sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  ssize_t n = ::recvfrom(fd, buf, cap, 0, reinterpret_cast<sockaddr*>(&ss), &slen);
+  if (n < 0) return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -1;
+  if (sender) *sender = from_sockaddr(ss);
+  return n;
+}
+
+bool UdpSock::send_to(const uint8_t* buf, size_t len, const NetAddr& dest) const {
+  sockaddr_storage ss;
+  socklen_t slen = to_sockaddr(dest, &ss);
+  if (::sendto(fd, buf, len, 0, reinterpret_cast<sockaddr*>(&ss), slen) ==
+      ssize_t(len))
+    return true;
+  // Transient buffer pressure is not a send failure: the reference's async
+  // send awaits writability, so only hard errors ever surface there — and a
+  // "failed" ping send removes the target immediately (Q7). A dropped
+  // datagram under pressure is indistinguishable from network loss, which
+  // the protocol already tolerates.
+  return errno == EAGAIN || errno == EWOULDBLOCK || errno == ENOBUFS;
+}
+
+std::optional<NetAddr> UdpSock::local_addr() const {
+  sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&ss), &slen) < 0) return std::nullopt;
+  return from_sockaddr(ss);
+}
+
+std::optional<UdpSock> bind_unicast(const NetAddr& ip_only) {
+  UdpSock s;
+  s.fd = socket(ip_only.v6 ? AF_INET6 : AF_INET, SOCK_DGRAM, IPPROTO_UDP);
+  if (!s.valid()) return std::nullopt;
+  if (!set_nonblocking_reuse(s.fd, /*reuse=*/false)) return std::nullopt;
+  sockaddr_storage ss;
+  NetAddr bindaddr = ip_only;
+  bindaddr.port = 0;
+  socklen_t slen = to_sockaddr(bindaddr, &ss);
+  if (bind(s.fd, reinterpret_cast<sockaddr*>(&ss), slen) < 0) return std::nullopt;
+  return s;
+}
+
+std::optional<BroadcastPair> open_broadcast(const NetAddr& bcast_ip, uint16_t port,
+                                            unsigned iface_index) {
+  BroadcastPair p;
+  p.dest = bcast_ip;
+  p.dest.port = port;
+
+  if (!bcast_ip.v6) {
+    // IPv4: one socket does both directions (networking.rs:32-67).
+    UdpSock s;
+    s.fd = socket(AF_INET, SOCK_DGRAM, IPPROTO_UDP);
+    if (!s.valid()) return std::nullopt;
+    int one = 1;
+    if (setsockopt(s.fd, SOL_SOCKET, SO_BROADCAST, &one, sizeof(one)) < 0)
+      return std::nullopt;
+    if (!set_nonblocking_reuse(s.fd, /*reuse=*/true)) return std::nullopt;
+    sockaddr_in any{};
+    any.sin_family = AF_INET;
+    any.sin_port = htons(port);
+    if (bind(s.fd, reinterpret_cast<sockaddr*>(&any), sizeof(any)) < 0)
+      return std::nullopt;
+    int fd2 = dup(s.fd);
+    if (fd2 < 0) return std::nullopt;
+    p.in = std::move(s);
+    p.out.fd = fd2;
+    return p;
+  }
+
+  // IPv6: join the multicast group on the interface for inbound; pin the
+  // egress interface for outbound (networking.rs:68-119).
+  UdpSock in;
+  in.fd = socket(AF_INET6, SOCK_DGRAM, IPPROTO_UDP);
+  if (!in.valid()) return std::nullopt;
+  ipv6_mreq mreq{};
+  std::memcpy(&mreq.ipv6mr_multiaddr, bcast_ip.ip.data(), 16);
+  mreq.ipv6mr_interface = iface_index;
+  if (setsockopt(in.fd, IPPROTO_IPV6, IPV6_JOIN_GROUP, &mreq, sizeof(mreq)) < 0)
+    return std::nullopt;
+  int one = 1;
+  if (setsockopt(in.fd, IPPROTO_IPV6, IPV6_V6ONLY, &one, sizeof(one)) < 0)
+    return std::nullopt;
+  if (!set_nonblocking_reuse(in.fd, /*reuse=*/true)) return std::nullopt;
+  sockaddr_in6 any{};
+  any.sin6_family = AF_INET6;
+  any.sin6_port = htons(port);
+  if (bind(in.fd, reinterpret_cast<sockaddr*>(&any), sizeof(any)) < 0)
+    return std::nullopt;
+
+  UdpSock out;
+  out.fd = socket(AF_INET6, SOCK_DGRAM, IPPROTO_UDP);
+  if (!out.valid()) return std::nullopt;
+  if (setsockopt(out.fd, IPPROTO_IPV6, IPV6_MULTICAST_IF, &iface_index,
+                 sizeof(iface_index)) < 0)
+    return std::nullopt;
+  if (!set_nonblocking_reuse(out.fd, /*reuse=*/true)) return std::nullopt;
+  sockaddr_in6 any0{};
+  any0.sin6_family = AF_INET6;
+  if (bind(out.fd, reinterpret_cast<sockaddr*>(&any0), sizeof(any0)) < 0)
+    return std::nullopt;
+
+  p.in = std::move(in);
+  p.out = std::move(out);
+  return p;
+}
+
+std::string list_interfaces() {
+  // One line per non-loopback address: "family,ip,ifindex,broadcast" where
+  // broadcast is the v4 subnet broadcast (empty for v6).
+  ifaddrs* ifs = nullptr;
+  if (getifaddrs(&ifs) != 0) return "";
+  std::string out;
+  for (ifaddrs* i = ifs; i; i = i->ifa_next) {
+    if (!i->ifa_addr || (i->ifa_flags & IFF_LOOPBACK) || !(i->ifa_flags & IFF_UP))
+      continue;
+    char host[INET6_ADDRSTRLEN] = {0};
+    unsigned idx = if_nametoindex(i->ifa_name);
+    if (i->ifa_addr->sa_family == AF_INET6) {
+      auto* s6 = reinterpret_cast<sockaddr_in6*>(i->ifa_addr);
+      inet_ntop(AF_INET6, &s6->sin6_addr, host, sizeof(host));
+      out += "6," + std::string(host) + "," + std::to_string(idx) + ",\n";
+    } else if (i->ifa_addr->sa_family == AF_INET) {
+      auto* s4 = reinterpret_cast<sockaddr_in*>(i->ifa_addr);
+      inet_ntop(AF_INET, &s4->sin_addr, host, sizeof(host));
+      char bc[INET_ADDRSTRLEN] = {0};
+      if (i->ifa_ifu.ifu_broadaddr && (i->ifa_flags & IFF_BROADCAST)) {
+        auto* sb = reinterpret_cast<sockaddr_in*>(i->ifa_ifu.ifu_broadaddr);
+        inet_ntop(AF_INET, &sb->sin_addr, bc, sizeof(bc));
+      }
+      out += "4," + std::string(host) + "," + std::to_string(idx) + "," + bc + "\n";
+    }
+  }
+  freeifaddrs(ifs);
+  return out;
+}
+
+std::string best_available_interface() {
+  // Reference policy (networking.rs:12-23): first non-loopback IPv6, else v4.
+  ifaddrs* ifs = nullptr;
+  if (getifaddrs(&ifs) != 0) return "";
+  std::string v6_pick, v4_pick;
+  for (ifaddrs* i = ifs; i; i = i->ifa_next) {
+    if (!i->ifa_addr || (i->ifa_flags & IFF_LOOPBACK) || !(i->ifa_flags & IFF_UP))
+      continue;
+    char host[INET6_ADDRSTRLEN] = {0};
+    unsigned idx = if_nametoindex(i->ifa_name);
+    if (i->ifa_addr->sa_family == AF_INET6 && v6_pick.empty()) {
+      auto* s6 = reinterpret_cast<sockaddr_in6*>(i->ifa_addr);
+      inet_ntop(AF_INET6, &s6->sin6_addr, host, sizeof(host));
+      v6_pick = std::string(host) + "," + std::to_string(idx);
+    } else if (i->ifa_addr->sa_family == AF_INET && v4_pick.empty()) {
+      auto* s4 = reinterpret_cast<sockaddr_in*>(i->ifa_addr);
+      inet_ntop(AF_INET, &s4->sin_addr, host, sizeof(host));
+      v4_pick = std::string(host) + "," + std::to_string(idx);
+    }
+  }
+  freeifaddrs(ifs);
+  return !v6_pick.empty() ? v6_pick : v4_pick;
+}
+
+}  // namespace kaboodle

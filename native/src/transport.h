@@ -1,0 +1,59 @@
+// UDP transport: unicast socket + broadcast/multicast pair.
+//
+// Reproduces the wire-level behavior of the reference's networking layer
+// (networking.rs:27-121): IPv4 uses one SO_BROADCAST socket bound to
+// 0.0.0.0:<port> with SO_REUSEADDR/SO_REUSEPORT sending to a broadcast
+// address; IPv6 joins the link-local multicast group ff02::1213:1989 on the
+// interface, with a separate outbound socket pinned to the interface index.
+// All sockets are non-blocking; the engine multiplexes with poll().
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+
+#include "wire.h"
+
+namespace kaboodle {
+
+struct UdpSock {
+  int fd = -1;
+
+  UdpSock() = default;
+  UdpSock(const UdpSock&) = delete;
+  UdpSock& operator=(const UdpSock&) = delete;
+  UdpSock(UdpSock&& o) noexcept : fd(o.fd) { o.fd = -1; }
+  UdpSock& operator=(UdpSock&& o) noexcept;
+  ~UdpSock();
+
+  bool valid() const { return fd >= 0; }
+  // >0: datagram size; 0: would-block; <0: error.
+  long recv_from(uint8_t* buf, size_t cap, NetAddr* sender) const;
+  bool send_to(const uint8_t* buf, size_t len, const NetAddr& dest) const;
+  std::optional<NetAddr> local_addr() const;
+};
+
+// Bind a unicast socket on ip:0 (ephemeral port = the peer's address,
+// kaboodle.rs:121-140).
+std::optional<UdpSock> bind_unicast(const NetAddr& ip_only);
+
+struct BroadcastPair {
+  UdpSock in;
+  UdpSock out;
+  NetAddr dest;  // where broadcasts are sent
+};
+
+// `bcast_ip` selects the family: a v4 broadcast address (255.255.255.255 or a
+// subnet broadcast) or a v6 multicast group (ff02::...). `iface_index` is
+// required for v6 (multicast join + egress pinning).
+std::optional<BroadcastPair> open_broadcast(const NetAddr& bcast_ip, uint16_t port,
+                                            unsigned iface_index);
+
+// The reference's interface policy (networking.rs:12-23): first non-loopback
+// IPv6 interface, else first non-loopback, as "ip,ifindex"; empty on none.
+std::string best_available_interface();
+
+// All non-loopback addresses, one "family,ip,ifindex,broadcast" line each.
+std::string list_interfaces();
+
+}  // namespace kaboodle

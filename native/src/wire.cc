@@ -1,0 +1,285 @@
+#include "wire.h"
+
+#include <arpa/inet.h>
+
+#include <cstdio>
+
+namespace kaboodle {
+
+// --- NetAddr --------------------------------------------------------------
+
+std::string NetAddr::to_string() const {
+  char host[INET6_ADDRSTRLEN] = {0};
+  char out[INET6_ADDRSTRLEN + 10];
+  if (v6) {
+    inet_ntop(AF_INET6, ip.data(), host, sizeof(host));
+    std::snprintf(out, sizeof(out), "[%s]:%u", host, unsigned(port));
+  } else {
+    inet_ntop(AF_INET, ip.data(), host, sizeof(host));
+    std::snprintf(out, sizeof(out), "%s:%u", host, unsigned(port));
+  }
+  return out;
+}
+
+std::optional<NetAddr> NetAddr::parse(const std::string& s) {
+  NetAddr a;
+  size_t colon;
+  std::string host;
+  if (!s.empty() && s[0] == '[') {
+    size_t close = s.find("]:");
+    if (close == std::string::npos) return std::nullopt;
+    host = s.substr(1, close - 1);
+    colon = close + 1;
+    a.v6 = true;
+  } else {
+    colon = s.rfind(':');
+    if (colon == std::string::npos) return std::nullopt;
+    host = s.substr(0, colon);
+    a.v6 = host.find(':') != std::string::npos;
+  }
+  unsigned long p = std::strtoul(s.c_str() + colon + 1, nullptr, 10);
+  if (p > 0xFFFF) return std::nullopt;
+  a.port = uint16_t(p);
+  int af = a.v6 ? AF_INET6 : AF_INET;
+  if (inet_pton(af, host.c_str(), a.ip.data()) != 1) return std::nullopt;
+  return a;
+}
+
+// --- little-endian writer / prefix reader --------------------------------
+
+namespace {
+
+struct Writer {
+  Bytes out;
+  void u8(uint8_t v) { out.push_back(v); }
+  void u16(uint16_t v) {
+    out.push_back(v & 0xFF);
+    out.push_back(v >> 8);
+  }
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; i++) out.push_back((v >> (8 * i)) & 0xFF);
+  }
+  void u64(uint64_t v) {
+    for (int i = 0; i < 8; i++) out.push_back((v >> (8 * i)) & 0xFF);
+  }
+  void raw(const uint8_t* p, size_t n) { out.insert(out.end(), p, p + n); }
+  void bytes(const Bytes& b) {  // serde bytes: u64 length + raw
+    u64(b.size());
+    raw(b.data(), b.size());
+  }
+  void addr(const NetAddr& a) {  // serde SocketAddr: variant + octets + port
+    u32(a.v6 ? 1 : 0);
+    raw(a.ip.data(), a.v6 ? 16 : 4);
+    u16(a.port);
+  }
+};
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  bool ok = true;
+
+  bool take(void* dst, size_t k) {
+    if (!ok || k > n) return ok = false;
+    std::memcpy(dst, p, k);
+    p += k;
+    n -= k;
+    return true;
+  }
+  uint8_t u8() {
+    uint8_t v = 0;
+    take(&v, 1);
+    return v;
+  }
+  uint16_t u16() {
+    uint8_t b[2] = {};
+    take(b, 2);
+    return uint16_t(b[0]) | uint16_t(b[1]) << 8;
+  }
+  uint32_t u32() {
+    uint8_t b[4] = {};
+    take(b, 4);
+    uint32_t v = 0;
+    for (int i = 3; i >= 0; i--) v = v << 8 | b[i];
+    return v;
+  }
+  uint64_t u64() {
+    uint8_t b[8] = {};
+    take(b, 8);
+    uint64_t v = 0;
+    for (int i = 7; i >= 0; i--) v = v << 8 | b[i];
+    return v;
+  }
+  Bytes bytes() {
+    uint64_t k = u64();
+    if (!ok || k > n) {
+      ok = false;
+      return {};
+    }
+    Bytes b(p, p + k);
+    p += k;
+    n -= k;
+    return b;
+  }
+  NetAddr addr() {
+    NetAddr a;
+    uint32_t tag = u32();
+    if (tag > 1) ok = false;
+    a.v6 = tag == 1;
+    take(a.ip.data(), a.v6 ? 16 : 4);
+    a.port = u16();
+    return a;
+  }
+};
+
+Message read_message(Reader& r) {
+  Message m;
+  uint32_t tag = r.u32();
+  if (tag > 4) {
+    r.ok = false;
+    return m;
+  }
+  m.kind = MsgKind(tag);
+  switch (m.kind) {
+    case MsgKind::Ping:
+      break;
+    case MsgKind::PingRequest:
+      m.peer = r.addr();
+      break;
+    case MsgKind::Ack:
+      m.peer = r.addr();
+      m.fingerprint = r.u32();
+      m.num_peers = r.u32();
+      break;
+    case MsgKind::KnownPeers: {
+      uint64_t count = r.u64();
+      for (uint64_t i = 0; r.ok && i < count; i++) {
+        NetAddr a = r.addr();
+        Bytes ident = r.bytes();
+        if (r.ok) m.known_peers.emplace(a, std::move(ident));
+      }
+      break;
+    }
+    case MsgKind::KnownPeersRequest:
+      m.fingerprint = r.u32();
+      m.num_peers = r.u32();
+      break;
+  }
+  return m;
+}
+
+void write_message(Writer& w, const Message& m) {
+  w.u32(uint32_t(m.kind));
+  switch (m.kind) {
+    case MsgKind::Ping:
+      break;
+    case MsgKind::PingRequest:
+      w.addr(m.peer);
+      break;
+    case MsgKind::Ack:
+      w.addr(m.peer);
+      w.u32(m.fingerprint);
+      w.u32(m.num_peers);
+      break;
+    case MsgKind::KnownPeers:
+      w.u64(m.known_peers.size());
+      for (const auto& [a, ident] : m.known_peers) {
+        w.addr(a);
+        w.bytes(ident);
+      }
+      break;
+    case MsgKind::KnownPeersRequest:
+      w.u32(m.fingerprint);
+      w.u32(m.num_peers);
+      break;
+  }
+}
+
+}  // namespace
+
+// --- public codec ---------------------------------------------------------
+
+Bytes encode_envelope(const Envelope& e) {
+  Writer w;
+  w.bytes(e.identity);
+  write_message(w, e.msg);
+  return std::move(w.out);
+}
+
+Bytes encode_broadcast(const Broadcast& b) {
+  Writer w;
+  w.u32(uint32_t(b.kind));
+  switch (b.kind) {
+    case BroadcastKind::Join:
+      w.addr(b.addr);
+      w.bytes(b.identity);
+      break;
+    case BroadcastKind::Failed:
+    case BroadcastKind::Probe:
+      w.addr(b.addr);
+      break;
+  }
+  return std::move(w.out);
+}
+
+Bytes encode_probe_response(const Bytes& identity) {
+  Writer w;
+  w.bytes(identity);
+  return std::move(w.out);
+}
+
+std::optional<Envelope> decode_envelope(const uint8_t* data, size_t len) {
+  Reader r{data, len};
+  Envelope e;
+  e.identity = r.bytes();
+  e.msg = read_message(r);
+  if (!r.ok) return std::nullopt;
+  return e;
+}
+
+std::optional<Broadcast> decode_broadcast(const uint8_t* data, size_t len) {
+  Reader r{data, len};
+  Broadcast b;
+  uint32_t tag = r.u32();
+  if (tag > 2) return std::nullopt;
+  b.kind = BroadcastKind(tag);
+  b.addr = r.addr();
+  if (b.kind == BroadcastKind::Join) b.identity = r.bytes();
+  if (!r.ok) return std::nullopt;
+  return b;
+}
+
+// --- CRC-32 (ISO-HDLC, the crc32fast/zlib polynomial) ---------------------
+
+namespace {
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const CrcTable kCrc;
+}  // namespace
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t crc) {
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = kCrc.t[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t fingerprint(const std::map<NetAddr, Bytes>& members) {
+  // std::map iterates in NetAddr order == Rust SocketAddr sort order.
+  uint32_t crc = 0;
+  for (const auto& [addr, identity] : members) {
+    std::string s = addr.to_string();
+    crc = crc32(reinterpret_cast<const uint8_t*>(s.data()), s.size(), crc);
+    crc = crc32(identity.data(), identity.size(), crc);
+  }
+  return crc;
+}
+
+}  // namespace kaboodle

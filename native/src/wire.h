@@ -1,0 +1,100 @@
+// Wire data model + bincode-compatible codec for the kaboodle protocol.
+//
+// Byte-compatible with the reference's `bincode::serialize` of the structs in
+// src/structs.rs (bincode 1.3 legacy config: little-endian, fixed-width ints,
+// u64 sequence/byte lengths, u32 enum variant tags; serde's non-human-readable
+// SocketAddr encoding: enum{V4,V6} + raw octets + u16 port).
+//
+// Decoders read a *prefix* of the buffer and tolerate trailing bytes — the
+// reference deserializes the whole zero-padded receive buffer (quirk Q2,
+// kaboodle.rs:259,397; discovery.rs:81), and probe replies depend on it (Q4).
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace kaboodle {
+
+using Bytes = std::vector<uint8_t>;
+
+// A peer address (the reference's `Peer = SocketAddr`). Ordering matches
+// Rust's `SocketAddr: Ord` (V4 < V6, then ip octets, then port) — the sort
+// the fingerprint depends on (kaboodle.rs:72-73).
+struct NetAddr {
+  bool v6 = false;
+  std::array<uint8_t, 16> ip{};  // v4 uses ip[0..4]
+  uint16_t port = 0;
+
+  friend bool operator==(const NetAddr& a, const NetAddr& b) {
+    return a.v6 == b.v6 && a.port == b.port && a.ip == b.ip;
+  }
+  friend bool operator<(const NetAddr& a, const NetAddr& b) {
+    if (a.v6 != b.v6) return !a.v6;
+    size_t n = a.v6 ? 16 : 4;
+    int c = std::memcmp(a.ip.data(), b.ip.data(), n);
+    if (c != 0) return c < 0;
+    return a.port < b.port;
+  }
+
+  // Rust `SocketAddr: Display` format: "a.b.c.d:port" / "[v6]:port".
+  std::string to_string() const;
+  static std::optional<NetAddr> parse(const std::string& s);
+};
+
+// SwimMessage variant tags, in declaration order (structs.rs:94-115).
+enum class MsgKind : uint32_t {
+  Ping = 0,
+  PingRequest = 1,
+  Ack = 2,
+  KnownPeers = 3,
+  KnownPeersRequest = 4,
+};
+
+// SwimBroadcast variant tags (structs.rs:65-73).
+enum class BroadcastKind : uint32_t { Join = 0, Failed = 1, Probe = 2 };
+
+// One decoded unicast message (the payload of a SwimEnvelope). Unused fields
+// are empty/zero for variants that do not carry them.
+struct Message {
+  MsgKind kind = MsgKind::Ping;
+  NetAddr peer{};                          // PingRequest / Ack
+  uint32_t fingerprint = 0;                // Ack / KnownPeersRequest
+  uint32_t num_peers = 0;                  // Ack / KnownPeersRequest
+  std::map<NetAddr, Bytes> known_peers{};  // KnownPeers
+};
+
+struct Envelope {
+  Bytes identity;
+  Message msg;
+};
+
+struct Broadcast {
+  BroadcastKind kind = BroadcastKind::Join;
+  NetAddr addr{};  // Join.addr / Failed peer / Probe addr
+  Bytes identity;  // Join only
+};
+
+// --- codec ---------------------------------------------------------------
+
+Bytes encode_envelope(const Envelope& e);
+Bytes encode_broadcast(const Broadcast& b);
+Bytes encode_probe_response(const Bytes& identity);
+
+// Prefix decoders (Q2): nullopt only on genuinely malformed/truncated input.
+std::optional<Envelope> decode_envelope(const uint8_t* data, size_t len);
+std::optional<Broadcast> decode_broadcast(const uint8_t* data, size_t len);
+
+// --- fingerprint (kaboodle.rs:71-83) -------------------------------------
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t crc = 0);
+
+// CRC-32 over peers sorted by address order: for each, the Display-format
+// address bytes then the raw identity bytes.
+uint32_t fingerprint(const std::map<NetAddr, Bytes>& members);
+
+}  // namespace kaboodle

@@ -1,0 +1,36 @@
+# Dev harness — the justfile equivalent (reference justfile:10-78).
+PYTHON ?= python
+PORT ?= 7475
+
+.PHONY: test native bench ci demo2 probe sim clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PYTHON) bench.py
+
+# run2x2 analogue: four real instances on this host for DURATION seconds,
+# output interleaved (the reference used zellij panes; justfile:10-12).
+demo2: native
+	@for i in 1 2 3 4; do \
+	  $(PYTHON) -m kaboodle_tpu --identity pane-$$i --interface v4 \
+	    --port $(PORT) --period-ms 250 --duration 8 & \
+	done; wait
+
+probe: native
+	$(PYTHON) -m kaboodle_tpu --probe --interface v4 --port $(PORT)
+
+sim:
+	$(PYTHON) -m kaboodle_tpu --sim 4096 --ticks 32
+
+# ci = test + compile-check of the driver entry points (justfile:30-34).
+ci: native test
+	$(PYTHON) -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,7 @@
+"""``python -m kaboodle_tpu`` — the demo CLI (see kaboodle_tpu.cli)."""
+
+import sys
+
+from kaboodle_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,199 @@
+"""CLI demo: the reference's demo app (main.rs) plus a simulator front-end.
+
+Real-network mode reproduces main.rs's behavior: join the mesh on a chosen
+interface/port, print an event-driven status table of peers (state, latency,
+identity) and the mesh fingerprint once per second, update the terminal
+title, and support probe mode (discover one member and exit, main.rs:70-84)
+and manual ping bootstrap (--ping addr, lib.rs:268-297).
+
+Sim mode is the TPU-native addition: run one of the benchmark scenarios (or a
+custom size) on the accelerator and stream per-tick convergence metrics.
+
+    python -m kaboodle_tpu --identity my-node            # join the LAN mesh
+    python -m kaboodle_tpu --probe                       # find a member, exit
+    python -m kaboodle_tpu --sim 4096 --ticks 32         # simulate on TPU
+    python -m kaboodle_tpu --sim-scenario 3              # BASELINE config 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kaboodle_tpu.errors import KaboodleError, NoAvailableInterfaces
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kaboodle_tpu", description="TPU-native SWIM mesh: demo CLI"
+    )
+    # Real-network args (main.rs:38-50).
+    p.add_argument("--identity", default=None, help="identity payload for this node")
+    p.add_argument(
+        "--interface",
+        default=None,
+        help="interface IP to bind, or 'v4'/'v6' to pick by family (default: "
+        "reference policy, IPv6-preferred non-loopback)",
+    )
+    p.add_argument("--port", type=int, default=7475, help="broadcast/multicast port")
+    p.add_argument("--probe", action="store_true", help="discover one member and exit")
+    p.add_argument("--ping", action="append", default=[], metavar="ADDR",
+                   help="manually ping ADDR after start (repeatable)")
+    p.add_argument("--period-ms", type=int, default=1000, help="protocol period")
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="exit after this many seconds (0 = run until ^C)")
+    # Simulator args.
+    p.add_argument("--sim", type=int, default=0, metavar="N",
+                   help="simulate N peers on the accelerator instead of joining a LAN")
+    p.add_argument("--sim-scenario", type=int, default=0, metavar="K",
+                   help="run BASELINE config K (1-5) on the accelerator")
+    p.add_argument("--ticks", type=int, default=64, help="sim ticks to run")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def resolve_interface(spec: str | None) -> tuple[str, int, str]:
+    """Resolve --interface to (ip, ifindex, broadcast_ip).
+
+    ``spec``: None (reference policy: IPv6-preferred), an explicit IP, or a
+    family name 'v4'/'v6' (main.rs:18-36 resolves name/ip/family similarly).
+    """
+    from kaboodle_tpu.transport.native import list_interfaces
+
+    ifaces = list_interfaces()
+    if not ifaces:
+        raise NoAvailableInterfaces("no non-loopback interface")
+
+    def bcast(i):
+        return i["broadcast"] if i["family"] == 4 else "ff02::1213:1989"
+
+    if spec in ("v4", "v6"):
+        fam = 4 if spec == "v4" else 6
+        for i in ifaces:
+            if i["family"] == fam:
+                return i["ip"], i["ifindex"], bcast(i)
+        raise NoAvailableInterfaces(f"no {spec} interface")
+    if spec:
+        for i in ifaces:
+            if i["ip"] == spec:
+                return i["ip"], i["ifindex"], bcast(i)
+        raise NoAvailableInterfaces(f"interface ip {spec!r} not found")
+    for i in ifaces:  # IPv6-preferred (networking.rs:12-23)
+        if i["family"] == 6:
+            return i["ip"], i["ifindex"], bcast(i)
+    i = ifaces[0]
+    return i["ip"], i["ifindex"], bcast(i)
+
+
+def format_peer_table(self_addr: str, peer_states: dict, peers: dict) -> str:
+    """The per-second status block (main.rs:198-225)."""
+    lines = []
+    for addr in sorted(peer_states):
+        state, latency = peer_states[addr]
+        ident = peers.get(addr, b"")
+        ident_s = ident.decode("utf-8", "replace") if isinstance(ident, bytes) else str(ident)
+        me = " (me)" if addr == self_addr else ""
+        lat = f"{latency:7.1f}ms" if isinstance(latency, (int, float)) else "        -"
+        lines.append(f"  {addr:<28} {state:<22} {lat}  {ident_s}{me}")
+    return "\n".join(lines)
+
+
+def run_real(args) -> int:
+    from kaboodle_tpu.transport import RealKaboodle, discover_mesh_member
+
+    ip, idx, bcast_ip = resolve_interface(args.interface)
+
+    if args.probe:
+        # Probe mode (main.rs:70-84): find one member, print, exit.
+        res = discover_mesh_member(
+            args.port, interface_ip=ip, broadcast_ip=bcast_ip, iface_index=idx
+        )
+        if res is None:
+            print("no mesh member found", file=sys.stderr)
+            return 1
+        addr, identity = res
+        print(f"{addr} {identity.decode('utf-8', 'replace')}")
+        return 0
+
+    identity = (args.identity or f"kaboodle-{int(time.time())}").encode()
+    node = RealKaboodle(
+        identity=identity,
+        broadcast_port=args.port,
+        interface_ip=ip,
+        broadcast_ip=bcast_ip,
+        iface_index=idx,
+        period_ms=args.period_ms,
+        ping_timeout_ms=2 * args.period_ms,
+        share_age_ms=10 * args.period_ms,
+        rebroadcast_ms=10 * args.period_ms,
+    )
+    node.start()
+    node.ping_addrs(args.ping)
+    self_addr = node.self_addr()
+    print(f"self: {self_addr} on {ip} (port {args.port})")
+    deadline = time.time() + args.duration if args.duration else None
+    try:
+        while deadline is None or time.time() < deadline:
+            time.sleep(min(args.period_ms / 1000.0, 1.0))
+            node.poll_events()
+            states = node.peer_states()
+            fp = node.fingerprint()
+            # Terminal title: "{addr} {n} {fp:08x}" (main.rs:189-192).
+            sys.stdout.write(f"\x1b]0;{self_addr} {len(states)} {fp:08x}\x07")
+            print(f"\n{len(states)} peers, fingerprint {fp:08x}")
+            print(format_peer_table(self_addr, states, node.peers()))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if node.is_running:
+            node.stop()
+        node.close()
+    return 0
+
+
+def run_sim(args) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim import Scenario, baseline_scenario, init_state, simulate
+
+    if args.sim_scenario:
+        sc = baseline_scenario(args.sim_scenario, n=args.sim or None,
+                               ticks=args.ticks, seed=args.seed)
+    else:
+        sc = Scenario(n=args.sim, ticks=args.ticks, seed=args.seed)
+    state = init_state(sc.n, seed=args.seed, alive=jnp.asarray(sc.initial_alive()))
+    t0 = time.perf_counter()
+    final, m = simulate(state, sc.build(), SwimConfig())
+    conv = np.asarray(m.converged)
+    wall = time.perf_counter() - t0
+    first = int(np.argmax(conv)) if conv.any() else -1
+    out = {
+        "n_peers": sc.n,
+        "ticks": sc.ticks,
+        "first_converged_tick": first,
+        "final_converged": bool(conv[-1]),
+        "final_agree_fraction": float(np.asarray(m.agree_fraction)[-1]),
+        "messages_delivered": int(np.asarray(m.messages_delivered).sum()),
+        "wall_s": round(wall, 3),
+    }
+    print(json.dumps(out))
+    return 0 if out["final_converged"] else 2
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.sim or args.sim_scenario:
+            return run_sim(args)
+        return run_real(args)
+    except KaboodleError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,12 +26,18 @@ def load_library() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     # Always invoke make: its dependency rules make this a no-op when the
-    # library is current, and pick up native/src edits when it is not.
+    # library is current, and pick up native/src edits when it is not. A
+    # build failure is fatal unless the existing library is newer than every
+    # source (i.e. the failure cannot mean "stale code would load").
     try:
         subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True)
     except (OSError, subprocess.CalledProcessError) as e:
         out = getattr(e, "stderr", b"") or b""
-        if not _LIB_PATH.exists():
+        stale = not _LIB_PATH.exists() or any(
+            src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+            for src in (_NATIVE_DIR / "src").glob("*")
+        )
+        if stale:
             raise IoError(f"native build failed: {out.decode(errors='replace')}") from e
     lib = ctypes.CDLL(str(_LIB_PATH))
 

@@ -17,16 +17,6 @@ char* dup_string(const std::string& s) {
   return out;
 }
 
-std::string hex(const Bytes& b) {
-  static const char* d = "0123456789abcdef";
-  std::string s;
-  for (uint8_t c : b) {
-    s.push_back(d[c >> 4]);
-    s.push_back(d[c & 15]);
-  }
-  return s;
-}
-
 const char* state_name(PeerStateKind k) {
   switch (k) {
     case PeerStateKind::Known:
@@ -92,14 +82,16 @@ int kb_is_running(kb_engine* h) {
 }
 
 char* kb_self_addr(kb_engine* h) {
+  if (!h) return dup_string("");
   return dup_string(h->impl->self_addr().to_string());
 }
 
 uint32_t kb_fingerprint(kb_engine* h) {
-  return h->impl->fingerprint_now();
+  return h ? h->impl->fingerprint_now() : 0;
 }
 
 char* kb_peers_json(kb_engine* h) {
+  if (!h) return dup_string("[]");
   std::ostringstream os;
   os << "[";
   bool first = true;
@@ -107,7 +99,7 @@ char* kb_peers_json(kb_engine* h) {
     if (!first) os << ",";
     first = false;
     os << "{\"addr\":\"" << addr.to_string() << "\",\"identity_hex\":\""
-       << hex(e.identity) << "\",\"state\":\"" << state_name(e.state)
+       << to_hex(e.identity) << "\",\"state\":\"" << state_name(e.state)
        << "\",\"latency_ms\":" << e.latency_ms << "}";
   }
   os << "]";
@@ -115,6 +107,7 @@ char* kb_peers_json(kb_engine* h) {
 }
 
 char* kb_events_json(kb_engine* h) {
+  if (!h) return dup_string("[]");
   std::ostringstream os;
   os << "[";
   bool first = true;
@@ -124,7 +117,7 @@ char* kb_events_json(kb_engine* h) {
     switch (ev.kind) {
       case EngineEvent::Discovered:
         os << "{\"type\":\"discovered\",\"addr\":\"" << ev.addr.to_string()
-           << "\",\"identity_hex\":\"" << hex(ev.identity) << "\"}";
+           << "\",\"identity_hex\":\"" << to_hex(ev.identity) << "\"}";
         break;
       case EngineEvent::Departed:
         os << "{\"type\":\"departed\",\"addr\":\"" << ev.addr.to_string() << "\"}";
@@ -139,6 +132,7 @@ char* kb_events_json(kb_engine* h) {
 }
 
 int kb_ping_addr(kb_engine* h, const char* addr) {
+  if (!h) return -1;
   auto a = NetAddr::parse(addr);
   if (!a) return -1;
   h->impl->ping_addr(*a);

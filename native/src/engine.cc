@@ -7,19 +7,6 @@
 
 namespace kaboodle {
 
-namespace {
-std::string hex(const Bytes& b) {
-  static const char* d = "0123456789abcdef";
-  std::string s;
-  s.reserve(b.size() * 2);
-  for (uint8_t c : b) {
-    s.push_back(d[c >> 4]);
-    s.push_back(d[c & 15]);
-  }
-  return s;
-}
-}  // namespace
-
 Engine::Engine(EngineConfig cfg) : cfg_(std::move(cfg)) {
   uint64_t seed = cfg_.rng_seed ? cfg_.rng_seed : std::random_device{}();
   rng_.seed(seed);
@@ -31,6 +18,9 @@ Engine::~Engine() {
 
 bool Engine::start() {
   if (running_) return false;
+  // Link-local v6 bind addresses need the interface as their scope.
+  if (cfg_.bind_ip.is_link_local_v6() && cfg_.bind_ip.scope == 0)
+    cfg_.bind_ip.scope = cfg_.iface_index;
   auto us = bind_unicast(cfg_.bind_ip);
   if (!us) return false;
   sock_ = std::move(*us);
@@ -563,7 +553,9 @@ void Engine::set_identity(Bytes identity) {
 std::string probe_mesh(const NetAddr& bind_ip, const NetAddr& bcast_ip, uint16_t port,
                        unsigned iface_index, uint32_t start_ms, double multiplier,
                        uint32_t cap_ms, uint32_t total_timeout_ms) {
-  auto us = bind_unicast(bind_ip);
+  NetAddr bip = bind_ip;
+  if (bip.is_link_local_v6() && bip.scope == 0) bip.scope = iface_index;
+  auto us = bind_unicast(bip);
   if (!us) return "";
   auto la = us->local_addr();
   if (!la) return "";
@@ -593,7 +585,7 @@ std::string probe_mesh(const NetAddr& bind_ip, const NetAddr& bcast_ip, uint16_t
         // Q4: the reply is a raw ProbeResponse but is parsed as an envelope —
         // works because the zero tail decodes as SwimMessage::Ping (Q2).
         if (auto env = decode_envelope(buf.data(), buf.size()))
-          return sender.to_string() + "|" + hex(env->identity);
+          return sender.to_string() + "|" + to_hex(env->identity);
       }
     }
     interval = std::min(double(cap_ms), interval * multiplier);

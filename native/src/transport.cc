@@ -22,6 +22,7 @@ socklen_t to_sockaddr(const NetAddr& a, sockaddr_storage* ss) {
     s6->sin6_family = AF_INET6;
     s6->sin6_port = htons(a.port);
     std::memcpy(&s6->sin6_addr, a.ip.data(), 16);
+    s6->sin6_scope_id = a.scope;  // required for link-local (fe80::) targets
     return sizeof(sockaddr_in6);
   }
   auto* s4 = reinterpret_cast<sockaddr_in*>(ss);
@@ -38,6 +39,7 @@ NetAddr from_sockaddr(const sockaddr_storage& ss) {
     a.v6 = true;
     std::memcpy(a.ip.data(), &s6->sin6_addr, 16);
     a.port = ntohs(s6->sin6_port);
+    a.scope = s6->sin6_scope_id;
   } else {
     const auto* s4 = reinterpret_cast<const sockaddr_in*>(&ss);
     a.v6 = false;

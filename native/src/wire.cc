@@ -271,6 +271,17 @@ uint32_t crc32(const uint8_t* data, size_t len, uint32_t crc) {
   return ~crc;
 }
 
+std::string to_hex(const Bytes& b) {
+  static const char* d = "0123456789abcdef";
+  std::string s;
+  s.reserve(b.size() * 2);
+  for (uint8_t c : b) {
+    s.push_back(d[c >> 4]);
+    s.push_back(d[c & 15]);
+  }
+  return s;
+}
+
 uint32_t fingerprint(const std::map<NetAddr, Bytes>& members) {
   // std::map iterates in NetAddr order == Rust SocketAddr sort order.
   uint32_t crc = 0;

@@ -29,6 +29,12 @@ struct NetAddr {
   bool v6 = false;
   std::array<uint8_t, 16> ip{};  // v4 uses ip[0..4]
   uint16_t port = 0;
+  // v6 scope (interface index) for link-local addresses. NOT part of
+  // identity/ordering/wire form — Rust's Display and the serde encoding both
+  // omit it — but required by the OS to bind/send fe80:: addresses.
+  uint32_t scope = 0;
+
+  bool is_link_local_v6() const { return v6 && ip[0] == 0xfe && (ip[1] & 0xc0) == 0x80; }
 
   friend bool operator==(const NetAddr& a, const NetAddr& b) {
     return a.v6 == b.v6 && a.port == b.port && a.ip == b.ip;
@@ -96,5 +102,7 @@ uint32_t crc32(const uint8_t* data, size_t len, uint32_t crc = 0);
 // CRC-32 over peers sorted by address order: for each, the Display-format
 // address bytes then the raw identity bytes.
 uint32_t fingerprint(const std::map<NetAddr, Bytes>& members);
+
+std::string to_hex(const Bytes& b);
 
 }  // namespace kaboodle

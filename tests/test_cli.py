@@ -1,0 +1,84 @@
+"""CLI demo app (main.rs equivalent): args, interface resolution, live demo."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from kaboodle_tpu.cli import build_parser, format_peer_table, main, resolve_interface
+from kaboodle_tpu.errors import NoAvailableInterfaces
+from kaboodle_tpu.transport.native import list_interfaces
+
+
+def test_parser_flags():
+    a = build_parser().parse_args(
+        ["--identity", "x", "--port", "7000", "--ping", "1.2.3.4:5", "--ping", "6.7.8.9:1"]
+    )
+    assert a.identity == "x" and a.port == 7000
+    assert a.ping == ["1.2.3.4:5", "6.7.8.9:1"]
+
+
+def test_resolve_interface():
+    ifaces = list_interfaces()
+    if not ifaces:
+        pytest.skip("no interfaces")
+    ip, idx, bcast = resolve_interface(None)  # IPv6-preferred reference policy
+    fams = {i["family"] for i in ifaces}
+    if 6 in fams:
+        assert ":" in ip and bcast == "ff02::1213:1989"
+    explicit = resolve_interface(ifaces[0]["ip"])
+    assert explicit[0] == ifaces[0]["ip"]
+    with pytest.raises(NoAvailableInterfaces):
+        resolve_interface("203.0.113.77")
+
+
+def test_format_peer_table():
+    out = format_peer_table(
+        "1.1.1.1:1",
+        {"1.1.1.1:1": ("Known", None), "2.2.2.2:2": ("WaitingForPing", 12.5)},
+        {"1.1.1.1:1": b"me", "2.2.2.2:2": b"you"},
+    )
+    assert "(me)" in out and "WaitingForPing" in out and "12.5ms" in out
+
+
+def test_sim_mode(capsys):
+    rc = main(["--sim", "64", "--ticks", "8"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["final_converged"] and out["n_peers"] == 64
+
+
+def test_sim_scenario_mode(capsys):
+    rc = main(["--sim-scenario", "1", "--ticks", "8"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["n_peers"] == 4
+
+
+def test_two_instance_live_demo():
+    """The run2x2 demo shape as a subprocess test: two CLI instances find each
+    other and report 2 peers with matching fingerprints."""
+    v4 = [i for i in list_interfaces() if i["family"] == 4 and i["broadcast"]]
+    if not v4:
+        pytest.skip("no broadcast-capable IPv4 interface")
+    cmd = [
+        sys.executable, "-m", "kaboodle_tpu",
+        "--interface", v4[0]["ip"], "--port", "18766",
+        "--period-ms", "100", "--duration", "5",
+    ]
+    procs = [
+        subprocess.Popen(
+            cmd + ["--identity", f"pane-{i}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=60)[0] for p in procs]
+    for out in outs:
+        assert "self: " in out
+        assert "2 peers" in out, out[-500:]
+    # Both ended at the same fingerprint (last reported line).
+    fps = {
+        [ln for ln in out.splitlines() if "fingerprint" in ln][-1].split()[-1]
+        for out in outs
+    }
+    assert len(fps) == 1

@@ -182,8 +182,17 @@ def make_tick_fn(
         escalate = has_timed & has_cand
         insta_remove = has_timed & ~has_cand  # no proxies -> drop now (:599-605)
 
-        proxies, proxies_valid = choose_k_members(
-            known_cand, cfg.num_indirect_ping_peers, key_proxy, det
+        # Escalations are rare (none at all in fault-free steady state), so the
+        # [N, N] gumbel + top_k proxy draw is gated; the zero indices in the
+        # skip branch are inert because proxies_valid is all-False then.
+        kk = min(cfg.num_indirect_ping_peers, n)
+        proxies, proxies_valid = jax.lax.cond(
+            jnp.any(escalate),
+            lambda: choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det),
+            lambda: (
+                jnp.zeros((n, kk), dtype=jnp.int32),
+                jnp.zeros((n, kk), dtype=bool),
+            ),
         )  # [N, k]
         proxies_valid &= escalate[:, None]
 
@@ -254,35 +263,41 @@ def make_tick_fn(
 
         # Join responses (kaboodle.rs:333-392): r replies to each *new* joiner
         # with probability max(1, 100-n^2)% where n tracks the sequentially
-        # growing map (cumulative inserts in origin order — exact parity).
-        n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
-        reply_p = broadcast_reply_prob(n_after)
-        bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
-        reply = is_new_ro & bern  # [r, o]
-        reply_del = reply & ok  # response unicast r -> o gated like any message
+        # growing map (cumulative inserts in origin order — exact parity), and
+        # the accepted replies union into a gossip share at the joiner.
+        # The whole block — [N, N] cumsums, the Bernoulli draw, and the two
+        # boolean matmuls — is gated on a join actually happening this tick
+        # (steady-state ticks have none); the skip branch's all-False outputs
+        # are exactly what the formulas produce with join_b all-False.
+        any_join = jnp.any(join_b)
 
-        # Gossip union at joiner o (deliverable in call 2): the reply share is
-        # r's map at reply time = start-of-round map + joiners accepted with
-        # origin index <= o (the oracle's sequential processing order):
-        #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
-        # Two boolean matmuls; skipped entirely on join-free ticks.
-        share_base = member_a
-        if cfg.max_share_peers and n > cfg.max_share_peers:
-            # D5: cap to lowest-index members of the start-of-round map.
-            within_cap = jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
-            share_base = member_a & within_cap
+        def _join_replies():
+            n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
+            reply_p = broadcast_reply_prob(n_after)
+            bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
+            reply = is_new_ro & bern  # [r, o]
+            reply_del_ = reply & ok  # response unicast r -> o gated like any message
 
-        def _gossip(_):
-            term1 = _bool_matmul(reply_del.T, share_base)  # [o, j]
-            term2 = _bool_matmul(reply_del.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
+            # Gossip union at joiner o (deliverable in call 2): the reply share
+            # is r's map at reply time = start-of-round map + joiners accepted
+            # with origin index <= o (the oracle's sequential processing order):
+            #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
+            share_base = member_a
+            if cfg.max_share_peers and n > cfg.max_share_peers:
+                # D5: cap to lowest-index members of the start-of-round map.
+                within_cap = (
+                    jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+                )
+                share_base = member_a & within_cap
+            term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
+            term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
             tri = idx[None, :] <= idx[:, None]  # j <= o
-            return term1 | (term2 & tri)
+            return reply_del_, term1 | (term2 & tri)
 
-        gossip = jax.lax.cond(
-            jnp.any(join_b),
-            _gossip,
-            lambda _: jnp.zeros((n, n), dtype=bool),
-            operand=None,
+        reply_del, gossip = jax.lax.cond(
+            any_join,
+            _join_replies,
+            lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
         )
 
         # ================= Call 1: Pings + PingRequests =======================
@@ -319,9 +334,13 @@ def make_tick_fn(
         T = jnp.where(mark2, t, T)
 
         # Gossip-learned peers insert back-dated (Q6) where still unknown.
-        gossip_new = gossip & ~(S > 0)
-        S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
-        T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
+        def _gossip_insert(S, T):
+            gossip_new = gossip & ~(S > 0)
+            S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
+            T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
+            return S, T
+
+        S, T = jax.lax.cond(any_join, _gossip_insert, lambda S, T: (S, T), S, T)
 
         member_2 = S > 0
         fp2, n2 = _fingerprint_and_count(member_2, rec_hash)
@@ -445,16 +464,31 @@ def make_tick_fn(
         # strictly within MAX_PEER_SHARE_AGE, excluding self (and the
         # requester — enforced receiver-side as j != i, same effect). Computed
         # post-marks, matching the oracle's two-pass delivery. Not capped (Q12).
-        share_f = (S == KNOWN) & ~eye & ((t - T) < cfg.max_peer_share_age_ticks)
+        # Requests only flow while fingerprints disagree, so the share/gather/
+        # insert passes are gated on one actually being delivered this tick.
         del_rep = del_kpr & _gather_edge(ok, partner, idx)  # partner -> requester
+        # The share snapshot is taken before the requester-marks-partner write
+        # below (the oracle's two-pass order): a partner's own fresh call-G
+        # marks must not leak into the rows it shares this tick.
+        S_share, T_share = S, T
         mark_rep = jnp.zeros((n, n), dtype=bool)
         mark_rep = _scatter_or(mark_rep, idx, partner, del_rep)  # requester marks partner
         S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
         T = jnp.where(mark_rep, t, T)
-        srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
-        rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
-        S = jnp.where(rep_ins, jnp.int8(KNOWN), S)
-        T = jnp.where(rep_ins, t - cfg.max_peer_share_age_ticks, T)
+
+        def _kpr_reply_insert(S, T):
+            share_f = (S_share == KNOWN) & ~eye & (
+                (t - T_share) < cfg.max_peer_share_age_ticks
+            )
+            srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
+            rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
+            S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
+            T2 = jnp.where(rep_ins, t - cfg.max_peer_share_age_ticks, T)
+            return S2, T2
+
+        S, T = jax.lax.cond(
+            jnp.any(del_rep), _kpr_reply_insert, lambda S, T: (S, T), S, T
+        )
 
         # ================= metrics + next state ===============================
         member_f = S > 0

@@ -1,0 +1,78 @@
+"""Tracing / profiling hooks for the simulator (SURVEY.md §5).
+
+The reference's only observability is ``log::debug!`` lines per send/recv
+(kaboodle.rs:190,213,266,404). The simulator gets three real tools:
+
+- :func:`trace` — a context manager around the JAX profiler; the captured
+  trace (TensorBoard / Perfetto format) shows the tick kernel's XLA ops,
+  fusion boundaries, and HBM traffic on the device timeline.
+- :func:`tick_stats` — per-tick structured metrics from a scan run, as a
+  NumPy record table (the tensor-reduction metrics are computed on device by
+  the kernel for free; this just fetches and tabulates).
+- :func:`log_run` — a compact human-readable per-tick trace of a run, the
+  simulator twin of the reference's RUST_LOG=debug output.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from kaboodle_tpu.sim.state import TickMetrics
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace of everything run inside the block.
+
+    Thin delegation to ``jax.profiler.trace`` (kept as the package's named
+    entry point for SURVEY.md §5 discoverability). View with TensorBoard
+    (`tensorboard --logdir <log_dir>`) or upload the .trace.json.gz to
+    Perfetto. First-compile noise included; for clean kernel timings run one
+    warmup call before entering.
+    """
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def tick_stats(metrics: TickMetrics) -> np.ndarray:
+    """Stacked per-tick metrics (from ``simulate``) -> structured NumPy table.
+
+    Fields mirror TickMetrics; one row per tick.
+    """
+    msgs = np.asarray(metrics.messages_delivered)
+    out = np.zeros(
+        msgs.shape[0],
+        dtype=[
+            ("tick", np.int32),
+            ("messages_delivered", np.int32),
+            ("converged", bool),
+            ("agree_fraction", np.float32),
+            ("mean_membership", np.float32),
+            ("fingerprint_min", np.uint32),
+            ("fingerprint_max", np.uint32),
+        ],
+    )
+    out["tick"] = np.arange(msgs.shape[0])
+    out["messages_delivered"] = msgs
+    out["converged"] = np.asarray(metrics.converged)
+    out["agree_fraction"] = np.asarray(metrics.agree_fraction)
+    out["mean_membership"] = np.asarray(metrics.mean_membership)
+    out["fingerprint_min"] = np.asarray(metrics.fingerprint_min)
+    out["fingerprint_max"] = np.asarray(metrics.fingerprint_max)
+    return out
+
+
+def log_run(metrics: TickMetrics, emit=print) -> None:
+    """Per-tick one-liners (the RUST_LOG=debug analogue, main.rs:54-58)."""
+    for row in tick_stats(metrics):
+        emit(
+            f"tick {row['tick']:>4}: msgs={row['messages_delivered']:<6} "
+            f"agree={row['agree_fraction']:.3f} "
+            f"members={row['mean_membership']:.1f} "
+            f"fp=[{row['fingerprint_min']:08x},{row['fingerprint_max']:08x}]"
+            f"{' CONVERGED' if row['converged'] else ''}"
+        )

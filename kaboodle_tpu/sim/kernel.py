@@ -184,14 +184,17 @@ def make_tick_fn(
 
         # Escalations are rare (none at all in fault-free steady state), so the
         # [N, N] gumbel + top_k proxy draw is gated; the zero indices in the
-        # skip branch are inert because proxies_valid is all-False then.
-        kk = min(cfg.num_indirect_ping_peers, n)
+        # skip branch are inert because proxies_valid is all-False then. The
+        # skip branch derives its shapes from the draw itself so the two
+        # branches cannot drift apart.
+        def _draw_proxies():
+            return choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det)
+
         proxies, proxies_valid = jax.lax.cond(
             jnp.any(escalate),
-            lambda: choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det),
-            lambda: (
-                jnp.zeros((n, kk), dtype=jnp.int32),
-                jnp.zeros((n, kk), dtype=bool),
+            _draw_proxies,
+            lambda: jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), jax.eval_shape(_draw_proxies)
             ),
         )  # [N, k]
         proxies_valid &= escalate[:, None]

@@ -1,0 +1,121 @@
+"""Property-based protocol invariants under randomized fault scenarios.
+
+Complements the exact parity pins (test_kernel_parity.py) with properties
+that must hold on EVERY trajectory, whatever the faults: these are the
+statements one would prove about the reference protocol, checked here by
+hypothesis over randomized scenarios on the real kernel.
+"""
+
+import functools
+
+import hypothesis
+import hypothesis.strategies as st
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import membership_fingerprint
+from kaboodle_tpu.sim import Scenario, init_state, simulate
+from kaboodle_tpu.spec import KNOWN
+
+SETTINGS = dict(max_examples=12, deadline=None,
+                suppress_health_check=[hypothesis.HealthCheck.too_slow])
+
+
+# Jitted so that hypothesis examples sharing a shape reuse the compiled scan
+# (an eager lax.scan re-traces per call; compiles dominate otherwise).
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _run(st0, inp, cfg):
+    return simulate(st0, inp, cfg)
+
+
+@st.composite
+def scenarios(draw):
+    # Shapes are drawn from a small set so XLA compiles once per shape and the
+    # examples vary only in data (seeds, rates, windows) — compile-bound
+    # otherwise.
+    n = draw(st.sampled_from([12, 16]))
+    ticks = draw(st.sampled_from([10, 16]))
+    seed = draw(st.integers(0, 2**31 - 1))
+    sc = Scenario(n=n, ticks=ticks, seed=seed)
+    if draw(st.booleans()):
+        sc.churn(draw(st.floats(0.0, 0.3)), protect=[0])
+    if draw(st.booleans()):
+        sc.drop(draw(st.floats(0.0, 0.5)))
+    if draw(st.booleans()):
+        groups = (np.arange(n) % draw(st.integers(2, 3))).astype(np.int32)
+        start = draw(st.integers(0, max(ticks - 2, 0)))
+        sc.partition_at(start, groups, until=draw(st.integers(start, ticks)))
+    return sc
+
+
+@hypothesis.given(scenarios())
+@hypothesis.settings(**SETTINGS)
+def test_core_invariants(sc):
+    cfg = SwimConfig()
+    st0 = init_state(sc.n, seed=sc.seed, alive=jnp.asarray(sc.initial_alive()))
+    final, m = _run(st0, sc.build(), cfg)
+
+    S = np.asarray(final.state)
+    T = np.asarray(final.timer)
+    alive = np.asarray(final.alive)
+    tick = int(final.tick)
+
+    # I1: aliveness follows the schedule exactly.
+    assert np.array_equal(alive, sc.alive_trajectory()[-1])
+
+    # I2: every alive peer has itself Known — self is inserted at start and
+    # nothing can remove it (kaboodle.rs:144-152; Failed(self) is ignored).
+    assert (np.diag(S)[alive] == KNOWN).all()
+
+    # I3: state codes stay in the 4-code alphabet and timers never run ahead
+    # of the clock.
+    assert S.min() >= 0 and S.max() <= 3
+    assert (T <= tick).all()
+
+    # I4: the metrics' convergence flag is exactly fingerprint agreement over
+    # alive rows of the final state.
+    fps = np.asarray(membership_fingerprint(jnp.asarray(S > 0), final.identity))
+    if alive.any():
+        agree = len(set(fps[alive].tolist())) == 1
+        assert bool(np.asarray(m.converged)[-1]) == agree
+
+    # I5: fingerprint equality <=> identical membership rows (for these sizes
+    # a mix-hash collision is ~2^-32; any hit would indicate a real bug).
+    rows = {}
+    member = S > 0
+    for i in np.flatnonzero(alive):
+        key = int(fps[i])
+        if key in rows:
+            assert np.array_equal(member[i], member[rows[key]]), (i, rows[key])
+        rows[key] = i
+
+
+@hypothesis.given(scenarios())
+@hypothesis.settings(**SETTINGS)
+def test_determinism(sc):
+    """Same seed + same schedule => bit-identical trajectory (the simulator's
+    race-detection substitute, SURVEY.md §5)."""
+    cfg = SwimConfig()
+    inp = sc.build()
+    st0 = init_state(sc.n, seed=sc.seed, alive=jnp.asarray(sc.initial_alive()))
+    a, ma = _run(st0, inp, cfg)
+    b, mb = _run(st0, inp, cfg)
+    assert jnp.array_equal(a.state, b.state)
+    assert jnp.array_equal(a.timer, b.timer)
+    assert jnp.array_equal(a.key, b.key)
+    assert jnp.array_equal(ma.messages_delivered, mb.messages_delivered)
+
+
+@hypothesis.given(st.sampled_from([8, 16, 32]), st.integers(0, 2**31 - 1))
+@hypothesis.settings(**SETTINGS)
+def test_faultfree_boot_converges(n, seed):
+    """I6: with no faults, a fresh mesh always reaches full membership and
+    agreement quickly (every peer broadcasts Join at tick 0; replies bootstrap
+    the rest; bound is generous)."""
+    cfg = SwimConfig()
+    final, m = _run(init_state(n, seed=seed),
+                    Scenario(n=n, ticks=8, seed=seed).build(), cfg)
+    assert bool(np.asarray(m.converged)[-1])
+    assert (np.asarray(final.state) > 0).all()

@@ -4,6 +4,7 @@ from kaboodle_tpu.parallel.mesh import (
     PEER_AXIS,
     inputs_specs,
     make_mesh,
+    make_multihost_mesh,
     make_sharded_tick,
     run_until_converged_sharded,
     shard_inputs,
@@ -16,6 +17,7 @@ __all__ = [
     "PEER_AXIS",
     "inputs_specs",
     "make_mesh",
+    "make_multihost_mesh",
     "make_sharded_tick",
     "run_until_converged_sharded",
     "shard_inputs",

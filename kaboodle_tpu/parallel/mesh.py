@@ -52,6 +52,45 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (PEER_AXIS,))
 
 
+def make_multihost_mesh(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> Mesh:
+    """Peer-axis mesh over every device in a multi-host (multi-slice) job.
+
+    Scaling beyond one slice is the same program over a bigger mesh: the peer
+    axis spans all global devices, device order keeps each host's devices
+    contiguous (so the heavy row-wise traffic stays on ICI within a slice and
+    only the collectives' inter-slice hops ride DCN — the SURVEY.md §2.3
+    mapping). Call once per process; under standard TPU pod launchers
+    (GKE/xmanager-style env vars) ``jax.distributed.initialize()`` needs no
+    arguments and the explicit parameters are for bring-your-own-cluster
+    setups.
+
+    Single-process runs (tests, one host) skip distributed init entirely and
+    return the same mesh as :func:`make_mesh`.
+    """
+    if coordinator_address or (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif jax.process_count() == 1:
+        # Zero-argument path: under pod launchers initialize() picks the
+        # cluster up from the environment; on a plain single host (or if
+        # distributed init already happened) it raises and we proceed with
+        # whatever devices exist.
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError):
+            pass
+    # jax.devices() is globally consistent and host-contiguous across
+    # processes — exactly the order we want on the peer axis.
+    return Mesh(np.asarray(jax.devices()), (PEER_AXIS,))
+
+
 def state_specs() -> MeshState:
     """PartitionSpecs for MeshState: row axis sharded, control scalars replicated."""
     row2 = P(PEER_AXIS, None)

@@ -106,3 +106,11 @@ def test_mesh_divisibility_check(mesh8):
         shard_state(init_state(30), mesh8)
     with pytest.raises(ValueError):
         shard_inputs(idle_inputs(30), mesh8)
+
+
+def test_multihost_mesh_single_process_fallback():
+    from kaboodle_tpu.parallel import make_multihost_mesh
+
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names == ("peers",)
+    assert mesh.size == len(jax.devices())

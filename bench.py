@@ -120,13 +120,63 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
     }
 
 
+def _accelerator_responsive(probe_timeout_s: int = 150) -> bool:
+    """Probe the default backend in a subprocess with a hard timeout.
+
+    The tunneled TPU backend can wedge indefinitely (observed: device init
+    hangs); a hung benchmark is worse than a degraded one, so when the probe
+    times out the bench falls back to the CPU backend and says so. The probe
+    runs in its own session with output discarded so a wedged child (or a
+    tunnel helper it spawned) can neither block the timeout on pipe EOF nor
+    survive the kill.
+    """
+    import os
+    import signal
+    import subprocess
+
+    code = "import jax; jax.devices()"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        return proc.wait(timeout=probe_timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        return False
+
+
 def main() -> None:
+    import os
+
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=0, help="peer count (0 = auto by platform)")
     p.add_argument("--ticks", type=int, default=32)
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the accelerator-responsiveness probe")
     args = p.parse_args()
 
-    import jax
+    # The probe costs one extra backend init, so skip it when the platform is
+    # already pinned to CPU (nothing to hang) or explicitly disabled.
+    probe_needed = not args.no_probe and os.environ.get("JAX_PLATFORMS") != "cpu"
+    fallback = probe_needed and not _accelerator_responsive()
+    if fallback:
+        print("bench: accelerator unresponsive; falling back to CPU backend",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        # The environment alone is not enough here: sitecustomize may already
+        # have imported jax and pinned the platform, so update the live config
+        # too (backends are created lazily; see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
 
     backend = jax.default_backend()
     n_chips = jax.device_count()
@@ -172,7 +222,7 @@ def main() -> None:
         "n_peers": used_n,
         "n_chips": n_chips,
         "sharded": sharded,
-        "backend": backend,
+        "backend": backend + (" (fallback: accelerator unresponsive)" if fallback else ""),
         "converged": result["converged"],
         "ticks_to_convergence": result["ticks_to_convergence"],
         "convergence_wall_s": round(result["convergence_wall_s"], 4),

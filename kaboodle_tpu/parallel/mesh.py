@@ -77,11 +77,13 @@ def make_multihost_mesh(
             num_processes=num_processes,
             process_id=process_id,
         )
-    elif jax.process_count() == 1:
+    else:
         # Zero-argument path: under pod launchers initialize() picks the
-        # cluster up from the environment; on a plain single host (or if
-        # distributed init already happened) it raises and we proceed with
-        # whatever devices exist.
+        # cluster up from the environment; on a plain single host (or if the
+        # backend/distributed runtime is already up) it raises and we proceed
+        # with whatever devices exist. NB: nothing backend-touching (e.g.
+        # jax.process_count()) may run before this call — initializing the
+        # backend first would make distributed init impossible.
         try:
             jax.distributed.initialize()
         except (RuntimeError, ValueError):

@@ -28,8 +28,18 @@ sim:
 	$(PYTHON) -m kaboodle_tpu --sim 4096 --ticks 32
 
 # ci = test + compile-check of the driver entry points (justfile:30-34).
+# Each driver step runs in its own process under `timeout` so a wedged
+# accelerator backend fails fast instead of eating the whole CI job; the
+# entry compile-check is pinned to CPU for the same reason (the driver runs
+# it on real hardware separately).
 ci: native test
-	$(PYTHON) -c "import __graft_entry__ as g; g.entry(); g.dryrun_multichip(8)"
+	timeout 420 $(PYTHON) __graft_entry__.py
+	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
+
+# Sharded scale proof: N=4096 over 8 virtual CPU devices, wall-clock and
+# peak-RSS logged (VERDICT r1 item 5). Not part of `ci` by default — ~minutes.
+scale-proof:
+	$(PYTHON) scripts/sharded_scale_proof.py --n 4096 --devices 8 --ticks 8
 
 clean:
 	$(MAKE) -C native clean

@@ -148,6 +148,10 @@ def _accelerator_responsive(probe_timeout_s: int = 150) -> bool:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             pass
+        try:
+            proc.wait(timeout=5)  # reap; no zombie for the rest of the bench
+        except subprocess.TimeoutExpired:
+            pass
         return False
 
 
@@ -204,8 +208,9 @@ def main() -> None:
             # Step down only on memory exhaustion; anything else is a real
             # bug and must surface as a traceback, not "all sizes failed".
             msg = str(e)
-            oom = ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
-                   or "Allocat" in msg)
+            oom = ("RESOURCE_EXHAUSTED" in msg
+                   or "out of memory" in msg.lower()
+                   or "failed to allocate" in msg.lower())
             if not oom or n == sizes[-1]:
                 raise
             print(f"bench: N={n} OOM ({type(e).__name__}); stepping down",

@@ -31,13 +31,21 @@ class CodecError(ValueError):
 
 
 def _encode_addr(addr: str) -> bytes:
-    if addr.startswith("["):
-        host, _, port = addr[1:].rpartition("]:")
-        ip = ipaddress.IPv6Address(host)
-        return struct.pack("<I", 1) + ip.packed + struct.pack("<H", int(port))
-    host, _, port = addr.rpartition(":")
-    ip = ipaddress.IPv4Address(host)
-    return struct.pack("<I", 0) + ip.packed + struct.pack("<H", int(port))
+    try:
+        if addr.startswith("["):
+            host, sep, port = addr[1:].rpartition("]:")
+            if not sep:
+                raise ValueError("missing ]:port")
+            ip6 = ipaddress.IPv6Address(host)
+            return struct.pack("<I", 1) + ip6.packed + struct.pack("<H", int(port))
+        host, sep, port = addr.rpartition(":")
+        if not sep:
+            raise ValueError("missing :port")
+        ip4 = ipaddress.IPv4Address(host)
+        return struct.pack("<I", 0) + ip4.packed + struct.pack("<H", int(port))
+    except (ValueError, struct.error) as e:
+        # Module contract: all malformed input surfaces as CodecError.
+        raise CodecError(f"bad address {addr!r}: {e}") from None
 
 
 class _Reader:

@@ -1,0 +1,98 @@
+"""Sharded-scale proof: run the faulty GSPMD scan well beyond toy shapes.
+
+Demonstrates that the sharded program (SURVEY.md §2.3 / BASELINE config 4)
+scales past the N=32 equivalence tests: N peers over D virtual CPU devices,
+full faulty tick (churn + partition + drop + manual pings) under lax.scan,
+with wall-clock and peak RSS logged. Run via ``make scale-proof``; results are
+recorded in SCALE_PROOF.md.
+
+Prints one JSON line, e.g.:
+    {"n": 4096, "devices": 8, "ticks": 8, "compile_s": ..., "run_s": ...,
+     "peak_rss_mib": ..., "peers_ticks_per_sec": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--ticks", type=int, default=8)
+    args = p.parse_args()
+
+    # Pin the virtual-CPU platform before JAX can initialize any backend
+    # (same ordering contract as tests/conftest.py / __graft_entry__.py).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", args.devices)
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.parallel import (
+        make_mesh,
+        shard_inputs,
+        shard_state,
+        simulate_sharded,
+    )
+    from kaboodle_tpu.sim.scenario import all_fault_paths_scenario
+    from kaboodle_tpu.sim.state import init_state
+
+    n, ticks = args.n, args.ticks
+    mesh = make_mesh(args.devices)
+    cfg = SwimConfig()
+    st = shard_state(init_state(n, seed=0), mesh)
+
+    # Same every-fault-path schedule the driver dry run validates, at scale.
+    inp = shard_inputs(
+        all_fault_paths_scenario(n, ticks=ticks, drop_rate=0.05).build(),
+        mesh,
+        stacked=True,
+    )
+
+    def run(s, i):
+        out, _ = simulate_sharded(s, i, cfg, mesh, faulty=True)
+        return out
+
+    t0 = time.perf_counter()
+    final = run(st, inp)
+    final.state.block_until_ready()
+    first_wall = time.perf_counter() - t0  # includes compile
+
+    t0 = time.perf_counter()
+    final = run(st, inp)
+    final.state.block_until_ready()
+    run_wall = time.perf_counter() - t0
+
+    assert final.state.shape == (n, n)
+    assert len(final.state.sharding.device_set) == args.devices, (
+        "final state not sharded across the full mesh"
+    )
+
+    peak_rss_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    line = {
+        "n": n,
+        "devices": args.devices,
+        "ticks": ticks,
+        "compile_s": round(first_wall - run_wall, 3),
+        "run_s": round(run_wall, 3),
+        "peers_ticks_per_sec": round(n * ticks / run_wall, 1),
+        "peak_rss_mib": round(peak_rss_mib, 1),
+        "backend": jax.default_backend(),
+        "faulty": True,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

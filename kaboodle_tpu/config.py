@@ -52,6 +52,15 @@ class SwimConfig:
     # the join-response path.
     max_share_peers: int = 300
 
+    # --- bootstrap ----------------------------------------------------------
+    # Join broadcasts (kaboodle.rs:228-251) make boot convergence instant on a
+    # shared broadcast domain: every peer learns every peer in one tick. False
+    # disables them (compiled out), modeling a mesh with no broadcast medium —
+    # membership then spreads only via direct traffic + anti-entropy
+    # (kaboodle.rs:707-740), the "gossip boot" the benchmark measures. Pair
+    # with ``init_state(ring_contacts=...)`` seed contacts.
+    join_broadcast_enabled: bool = True
+
     # --- parity flags for behavioral quirks (SURVEY.md §8) ------------------
     # Q1/Q11: an inbound datagram marks its *sender* Known (kaboodle.rs:408-415);
     # a forwarded indirect-ping Ack therefore resurrects the proxy, NOT the

@@ -52,12 +52,19 @@ def membership_fingerprint(member: jax.Array, identities: jax.Array) -> jax.Arra
 
     Args:
       member: bool ``[N, N]``; member[i, j] == peer i has peer j in its map.
-      identities: uint32 ``[N]`` identity word per peer.
+      identities: uint32 ``[N]`` global identity word per peer, or ``[N, N]``
+        per-row identity *views* (``MeshState.id_view`` — row i hashes the
+        identities it has actually seen, the traffic-driven model).
     Returns uint32 ``[N]``: fingerprint of each peer's view.
 
     Replaces ``generate_fingerprint`` (kaboodle.rs:71-83) for on-device use;
     wraparound uint32 addition == mod 2^32.
     """
-    h = peer_record_hash(jnp.arange(member.shape[-1], dtype=jnp.uint32), identities)
-    contrib = jnp.where(member, h[None, :], jnp.uint32(0))
+    pid = jnp.arange(member.shape[-1], dtype=jnp.uint32)
+    if identities.ndim == 2:
+        h = peer_record_hash(pid[None, :], identities)  # [N, N]
+        contrib = jnp.where(member, h, jnp.uint32(0))
+    else:
+        h = peer_record_hash(pid, identities)
+        contrib = jnp.where(member, h[None, :], jnp.uint32(0))
     return jnp.sum(contrib, axis=-1, dtype=jnp.uint32)

@@ -72,14 +72,6 @@ from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
 _I32MAX = jnp.iinfo(jnp.int32).max
 
 
-def _fingerprint_and_count(member: jax.Array, rec_hash: jax.Array):
-    """Row fingerprints (commutative mix-hash) + row membership counts."""
-    contrib = jnp.where(member, rec_hash[None, :], jnp.uint32(0))
-    fp = jnp.sum(contrib, axis=-1, dtype=jnp.uint32)
-    n = jnp.sum(member, axis=-1, dtype=jnp.int32)
-    return fp, n
-
-
 def _bool_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
     """Boolean OR-matmul: (a @ b) > 0 with int8 inputs, int32 accumulation.
 
@@ -124,7 +116,13 @@ def make_tick_fn(
         key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
 
         S, T = st.state, st.timer
+        lat, idv = st.latency, st.id_view
+        has_lat = lat is not None
+        has_idv = idv is not None
         alive, never_b, last_b = st.alive, st.never_broadcast, st.last_broadcast
+        # Every in-tick identity write is the sender's *current* word — exactly
+        # what the envelope would carry this period (structs.rs:77-83).
+        id_row = st.identity[None, :]
 
         # ---- churn: silent kill (Q8) + revive-with-reset (lockstep.revive) ----
         if faulty:
@@ -132,6 +130,12 @@ def make_tick_fn(
             rv = inp.revive
             S = jnp.where(rv[:, None], jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)), S)
             T = jnp.where(rv[:, None], jnp.where(eye, t, 0), T)
+            if has_lat:
+                lat = jnp.where(rv[:, None], jnp.nan, lat)
+            if has_idv:
+                idv = jnp.where(
+                    rv[:, None], jnp.where(eye, id_row, jnp.uint32(0)), idv
+                )
             never_b = never_b | rv
         else:
             rv = jnp.zeros((n,), dtype=bool)
@@ -151,16 +155,54 @@ def make_tick_fn(
         member0 = S > 0
         row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
         rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
+        u_row = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (n, n))
+
+        def fp_count(member, idv_now):
+            """Row fingerprints + membership counts at a point in the tick.
+
+            With identity views, each row hashes the identities it has actually
+            seen (engine.fingerprint() over its own records); otherwise the
+            global ``rec_hash`` vector (instant-identity fast mode)."""
+            if has_idv:
+                contrib = jnp.where(member, peer_record_hash(u_row, idv_now), jnp.uint32(0))
+            else:
+                contrib = jnp.where(member, rec_hash[None, :], jnp.uint32(0))
+            fp = jnp.sum(contrib, axis=-1, dtype=jnp.uint32)
+            return fp, jnp.sum(member, axis=-1, dtype=jnp.int32)
+
+        def apply_marks(S, T, lat, idv, mark):
+            """Q1 mark pass for one delivery wave: mark[d, s] == a datagram
+            from s reached d this wave. Latency EWMA sampled where the marked
+            entry was in a waiting state (kaboodle.rs:789-817, f32 like the
+            oracle); identity view refreshed from the envelope."""
+            if has_lat:
+                waiting = (S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)
+                sample = (t - T).astype(jnp.float32)
+                upd = jnp.where(
+                    jnp.isnan(lat),
+                    sample,
+                    jnp.float32(0.8) * sample + jnp.float32(0.2) * lat,
+                )
+                lat = jnp.where(mark & waiting, upd, lat)
+            if has_idv:
+                idv = jnp.where(mark, id_row, idv)
+            S = jnp.where(mark, jnp.int8(KNOWN), S)
+            T = jnp.where(mark, t, T)
+            return S, T, lat, idv
 
         # ================= A. Active phase (kaboodle.rs:746-757) ==============
         # A1: maybe_broadcast_join (kaboodle.rs:228-251): first call always
         # broadcasts; afterwards only while lonely and rebroadcast-interval old.
-        lonely = row_count0 <= 1
-        join_b = alive & (
-            never_b | (lonely & ((t - last_b) >= cfg.rebroadcast_interval_ticks))
-        )
-        last_b = jnp.where(join_b, t, last_b)
-        never_b = never_b & ~join_b
+        # With broadcasts disabled (gossip boot) the whole block compiles out.
+        if cfg.join_broadcast_enabled:
+            lonely = row_count0 <= 1
+            join_b = alive & (
+                never_b | (lonely & ((t - last_b) >= cfg.rebroadcast_interval_ticks))
+            )
+            last_b = jnp.where(join_b, t, last_b)
+            never_b = never_b & ~join_b
+        else:
+            join_b = jnp.zeros((n,), dtype=bool)
 
         # A2: handle_suspected_peers (kaboodle.rs:558-653) on the pre-tick
         # snapshot (the oracle iterates a snapshot taken at entry).
@@ -206,6 +248,10 @@ def make_tick_fn(
         jstar_cell = idx[None, :] == jstar[:, None]
         rem |= insta_remove[:, None] & jstar_cell
         S = jnp.where(rem, jnp.int8(0), S)
+        if has_lat:
+            # _remove drops the whole record: a re-learned peer starts with no
+            # latency history (kaboodle.rs:643-644).
+            lat = jnp.where(rem, jnp.nan, lat)
         # The accompanying Failed broadcasts are inert in the reference (quirk
         # Q3) — modeled only in intended-semantics mode below.
         esc_cell = escalate[:, None] & jstar_cell
@@ -236,11 +282,18 @@ def make_tick_fn(
 
         # ================= B. Broadcast delivery (kaboodle.rs:256-311) ========
         # Join o accepted at r: Jm[r, o]. Receivers insert the joiner as
-        # Known(now), preserving nothing else (kaboodle.rs:284-304).
-        Jm = join_b[None, :] & ok.T & ~eye  # [receiver, origin]
-        is_new_ro = Jm & ~member_a
-        S = jnp.where(Jm, jnp.int8(KNOWN), S)
-        T = jnp.where(Jm, t, T)
+        # Known(now) with the broadcast identity, preserving a prior latency
+        # (kaboodle.rs:284-304, :291-297).
+        if cfg.join_broadcast_enabled:
+            Jm = join_b[None, :] & ok.T & ~eye  # [receiver, origin]
+            is_new_ro = Jm & ~member_a
+            S = jnp.where(Jm, jnp.int8(KNOWN), S)
+            T = jnp.where(Jm, t, T)
+            if has_idv:
+                idv = jnp.where(Jm, id_row, idv)
+        else:
+            Jm = jnp.zeros((n, n), dtype=bool)
+            is_new_ro = Jm
 
         if not cfg.faithful_failed_broadcast:
             # Failed(j) broadcast by i, delivered to r (r != j): remove j.
@@ -263,6 +316,8 @@ def make_tick_fn(
                 operand=None,
             )
             S = jnp.where(fail_del, jnp.int8(0), S)
+            if has_lat:
+                lat = jnp.where(fail_del, jnp.nan, lat)
 
         # Join responses (kaboodle.rs:333-392): r replies to each *new* joiner
         # with probability max(1, 100-n^2)% where n tracks the sequentially
@@ -271,8 +326,9 @@ def make_tick_fn(
         # The whole block — [N, N] cumsums, the Bernoulli draw, and the two
         # boolean matmuls — is gated on a join actually happening this tick
         # (steady-state ticks have none); the skip branch's all-False outputs
-        # are exactly what the formulas produce with join_b all-False.
-        any_join = jnp.any(join_b)
+        # are exactly what the formulas produce with join_b all-False. With
+        # broadcasts compiled out there is never a join, so the gate is static.
+        any_join = jnp.any(join_b) if cfg.join_broadcast_enabled else jnp.bool_(False)
 
         def _join_replies():
             n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
@@ -297,11 +353,14 @@ def make_tick_fn(
             tri = idx[None, :] <= idx[:, None]  # j <= o
             return reply_del_, term1 | (term2 & tri)
 
-        reply_del, gossip = jax.lax.cond(
-            any_join,
-            _join_replies,
-            lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
-        )
+        if cfg.join_broadcast_enabled:
+            reply_del, gossip = jax.lax.cond(
+                any_join,
+                _join_replies,
+                lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
+            )
+        else:
+            reply_del = gossip = jnp.zeros((n, n), dtype=bool)
 
         # ================= Call 1: Pings + PingRequests =======================
         ok_ping = has_ping & _gather_edge(ok, idx, ping_tgt)
@@ -312,11 +371,10 @@ def make_tick_fn(
         mark1 = _scatter_or(mark1, ping_tgt, idx, ok_ping)
         mark1 = _scatter_or(mark1, man_tgt, idx, ok_man)
         mark1 = _scatter_or(mark1, proxies, idx[:, None], del_pr)
-        S = jnp.where(mark1, jnp.int8(KNOWN), S)
-        T = jnp.where(mark1, t, T)
+        S, T, lat, idv = apply_marks(S, T, lat, idv, mark1)
 
         member_1 = S > 0
-        fp1, n1 = _fingerprint_and_count(member_1, rec_hash)
+        fp1, n1 = fp_count(member_1, idv)
 
         # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
         # proxies' Pings to the suspect (kaboodle.rs:533-545).
@@ -333,20 +391,28 @@ def make_tick_fn(
             mark2, jnp.broadcast_to(jstar[:, None], proxies.shape), proxies, del_pping
         )  # suspect marks proxy
         mark2 |= reply_del.T  # joiner marks join-responder
-        S = jnp.where(mark2, jnp.int8(KNOWN), S)
-        T = jnp.where(mark2, t, T)
+        S, T, lat, idv = apply_marks(S, T, lat, idv, mark2)
 
-        # Gossip-learned peers insert back-dated (Q6) where still unknown.
-        def _gossip_insert(S, T):
-            gossip_new = gossip & ~(S > 0)
-            S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
-            T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
-            return S, T
+        # Gossip-learned peers insert back-dated (Q6) where still unknown, with
+        # identity words resolved to the peers' current identities (deviation
+        # D-ID1 — shared with the lockstep oracle; the native engine carries
+        # the sharer's view faithfully).
+        if cfg.join_broadcast_enabled:
 
-        S, T = jax.lax.cond(any_join, _gossip_insert, lambda S, T: (S, T), S, T)
+            def _gossip_insert(S, T, idv):
+                gossip_new = gossip & ~(S > 0)
+                S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
+                T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
+                if has_idv:
+                    idv = jnp.where(gossip_new, id_row, idv)
+                return S, T, idv
+
+            S, T, idv = jax.lax.cond(
+                any_join, _gossip_insert, lambda S, T, idv: (S, T, idv), S, T, idv
+            )
 
         member_2 = S > 0
-        fp2, n2 = _fingerprint_and_count(member_2, rec_hash)
+        fp2, n2 = fp_count(member_2, idv)
 
         # Queued: the suspect's Acks back to the proxies.
         del_pack = del_pping & _gather_edge(ok, jstar[:, None], proxies)  # [N, k]
@@ -371,8 +437,7 @@ def make_tick_fn(
             mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
         )  # proxy marks suspect — the proxy's own view resurrects (Q1)
         mark3 = _scatter_or(mark3, idx[:, None], proxies, del_fwd_c)  # suspector marks pinger-proxy
-        S = jnp.where(mark3, jnp.int8(KNOWN), S)
-        T = jnp.where(mark3, t, T)
+        S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
 
         # Proxy forwards the suspect's Ack (fp2 payload) unless the curious
         # entry was already popped by the call-2 coincidence.
@@ -385,8 +450,7 @@ def make_tick_fn(
         # WaitingForIndirectPing (kaboodle.rs:408-415 applies to the sender).
         mark4 = jnp.zeros((n, n), dtype=bool)
         mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
-        S = jnp.where(mark4, jnp.int8(KNOWN), S)
-        T = jnp.where(mark4, t, T)
+        S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
         if not cfg.faithful_indirect_ack:
             # Intended-SWIM mode: a forwarded ack clears the suspect too.
             cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
@@ -396,7 +460,7 @@ def make_tick_fn(
 
         # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
         member_g = S > 0
-        fp_g, n_g = _fingerprint_and_count(member_g, rec_hash)
+        fp_g, n_g = fp_count(member_g, idv)
 
         # Candidate priority = phase_base + sender index; first match wins
         # (take_sync_request scans in arrival order). Match condition:
@@ -460,8 +524,7 @@ def make_tick_fn(
         del_kpr = has_req & _gather_edge(ok, idx, partner)
         mark_g = jnp.zeros((n, n), dtype=bool)
         mark_g = _scatter_or(mark_g, partner, idx, del_kpr)  # partner marks requester
-        S = jnp.where(mark_g, jnp.int8(KNOWN), S)
-        T = jnp.where(mark_g, t, T)
+        S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
 
         # Filtered reply share (kaboodle.rs:483-501): Known peers heard from
         # strictly within MAX_PEER_SHARE_AGE, excluding self (and the

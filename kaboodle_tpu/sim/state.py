@@ -48,6 +48,16 @@ class MeshState:
     kpr_n: jax.Array  # int32 [N]
     tick: jax.Array  # int32 scalar
     key: jax.Array  # PRNG key (counter-based; the ChaChaRng analogue, kaboodle.rs:164)
+    # Per-edge latency EWMA in ticks (kaboodle.rs:789-817, weight 0.8 newest;
+    # NaN = no sample yet, the reference's Option::None). None compiles the
+    # tracking out (a memory/bandwidth saver for throughput benches).
+    latency: jax.Array | None = None  # float32 [N, N]
+    # id_view[i, j]: the identity word peer i last saw for peer j — carried by
+    # every envelope (structs.rs:77-83) and applied at the Q1 mark, so a
+    # set_identity spreads via traffic exactly like the reference
+    # (lib.rs:323-336). None = the D-API1 instant-visibility fast mode: all
+    # rows read the global ``identity`` vector.
+    id_view: jax.Array | None = None  # uint32 [N, N]
 
     @property
     def n(self) -> int:
@@ -92,19 +102,37 @@ def init_state(
     identities: jax.Array | None = None,
     seed: int = 0,
     alive: jax.Array | None = None,
+    ring_contacts: int = 0,
+    track_latency: bool = True,
+    instant_identity: bool = False,
 ) -> MeshState:
     """Fresh mesh: every peer knows only itself (kaboodle.rs:144-152) and will
-    broadcast Join on its first active phase (kaboodle.rs:228-251)."""
+    broadcast Join on its first active phase (kaboodle.rs:228-251).
+
+    ``ring_contacts=c`` additionally seeds peer i with Known entries for
+    peers (i+1..i+c) mod n — out-of-band bootstrap contacts for the gossip
+    boot (``SwimConfig(join_broadcast_enabled=False)``), where membership must
+    spread via traffic + anti-entropy instead of the broadcast domain.
+    ``track_latency=False`` / ``instant_identity=True`` drop the optional
+    [N, N] tensors (see MeshState) for throughput/memory-bound runs.
+    """
     idx = jnp.arange(n, dtype=jnp.int32)
     eye = idx[:, None] == idx[None, :]
     if identities is None:
         # LockstepMesh's default: identity word = index + 1.
         identities = (idx + 1).astype(jnp.uint32)
+    identities = jnp.asarray(identities, dtype=jnp.uint32)
+    member = eye
+    if ring_contacts:
+        if ring_contacts >= n:
+            raise ValueError("ring_contacts must be < n")
+        delta = (idx[None, :] - idx[:, None]) % n
+        member = member | (delta <= ring_contacts)
     return MeshState(
-        state=jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)),
+        state=jnp.where(member, jnp.int8(KNOWN), jnp.int8(0)),
         timer=jnp.zeros((n, n), dtype=jnp.int32),
         alive=jnp.ones((n,), dtype=bool) if alive is None else alive,
-        identity=jnp.asarray(identities, dtype=jnp.uint32),
+        identity=identities,
         never_broadcast=jnp.ones((n,), dtype=bool),
         last_broadcast=jnp.zeros((n,), dtype=jnp.int32),
         kpr_partner=jnp.full((n,), -1, dtype=jnp.int32),
@@ -112,6 +140,11 @@ def init_state(
         kpr_n=jnp.zeros((n,), dtype=jnp.int32),
         tick=jnp.int32(0),
         key=jax.random.PRNGKey(seed),
+        latency=None if not track_latency else jnp.full((n, n), jnp.nan, dtype=jnp.float32),
+        # Seed identity views with the boot identities: entries are only read
+        # for members, and every membership-creating path rewrites them, so
+        # this just fixes the view of self + bootstrap contacts.
+        id_view=None if instant_identity else jnp.broadcast_to(identities[None, :], (n, n)),
     )
 
 

@@ -17,11 +17,14 @@ delivers events to each attached instance's streams.
 
 Documented deviations from the reference:
 
-- *Identity words are globally visible* (D-API1): the kernel keeps one
-  ``identity[N]`` vector shared by all rows, so a ``set_identity`` is seen by
-  every peer's fingerprint immediately instead of spreading via envelopes.
-  Consumer-supplied identity *bytes* are kept host-side per network and
-  resolved in ``peers()``; the on-device word is their CRC-32.
+- *Identity payload resolution is host-side*: the kernel propagates identity
+  words via traffic (``MeshState.id_view``, refreshed at every Q1 mark — the
+  envelope semantics of structs.rs:77-83), but consumer-supplied identity
+  *bytes* are kept host-side per network and resolved in ``peers()`` from the
+  current instances; the on-device word is their CRC-32. Facade-level
+  ``fingerprint()`` hashes the caller's membership against current identity
+  words (D-API1: a ``set_identity`` is visible to these *queries* immediately,
+  while the kernel's internal convergence metric honors per-row views).
 - *Restart keeps the address* (D-API2): the reference re-binds an ephemeral
   port on ``start()`` after ``stop()`` (a new address); a simulated instance
   keeps its index, re-entering with a reset row + Join broadcast — the same
@@ -83,6 +86,10 @@ class SimNetwork:
         )
         self._partition = np.zeros(capacity, dtype=np.int32)
         self._drop_rate = 0.0
+        # Host-side RNG derived from the network seed (probe member choice):
+        # keeps sim runs reproducible from (cfg, seed) and never touches the
+        # global numpy stream.
+        self._rng = np.random.default_rng(seed)
         self.metrics: TickMetrics | None = None  # last tick's metrics
 
     # ---- slots -------------------------------------------------------------
@@ -148,6 +155,24 @@ class SimNetwork:
             if bool(m.converged):
                 return t
         raise ConvergenceTimeout(f"no fingerprint agreement within {max_ticks} ticks")
+
+    def discover_mesh_member(self) -> tuple[int, object]:
+        """Find one mesh member without joining (discovery.rs:30-89,
+        lib.rs:359-368): returns ``(address, identity)`` of a running
+        instance.
+
+        The reference broadcasts ``Probe`` with backoff until any member
+        replies (reply probability max(1, 100-n^2)%, kaboodle.rs:344-353) and
+        returns the first reply — an arbitrary member. The sim's broadcast
+        domain is the network object itself, so the probe resolves instantly;
+        with nobody running it raises :class:`InvalidOperation` instead of
+        backing off forever (deviation: the reference loops indefinitely,
+        discovery.rs:51-72, which a synchronous facade cannot)."""
+        running = [s for s, i in sorted(self._instances.items()) if i.is_running]
+        if not running:
+            raise InvalidOperation("no running instances to discover")
+        slot = running[0] if self.cfg.deterministic else int(self._rng.choice(running))
+        return slot, self._instances[slot]._identity
 
     def _deliver_events(self) -> None:
         from kaboodle_tpu.ops.hashing import membership_fingerprint
@@ -231,16 +256,24 @@ class Kaboodle:
             out[int(j)] = inst._identity if inst is not None else int(ids[j])
         return out
 
-    def peer_states(self) -> dict[int, tuple[str, int]]:
-        """peer index -> (state name, last-heard/sent-at tick) (lib.rs:348-354).
+    def peer_states(self) -> dict[int, tuple[str, int, float | None]]:
+        """peer index -> (state name, last-heard/sent-at tick, latency EWMA)
+        (lib.rs:348-354).
 
-        The reference also reports a latency EWMA (kaboodle.rs:789-817); the
-        lockstep simulator's latency is identically one tick, so the timing
-        column here is the tick stamp instead."""
+        Latency is the per-peer EWMA in ticks the kernel tracks
+        (kaboodle.rs:789-817, weight 0.8 newest); ``None`` when no sample has
+        been taken yet (the reference's ``Option::None``) or when the network
+        runs a ``track_latency=False`` lean state."""
         row = self._row()
         timer = np.asarray(self._net.state.timer[self._slot])
+        lat_row = self._net.state.latency
+        lat = None if lat_row is None else np.asarray(lat_row[self._slot])
         return {
-            int(j): (STATE_NAMES[int(row[j])], int(timer[j]))
+            int(j): (
+                STATE_NAMES[int(row[j])],
+                int(timer[j]),
+                None if lat is None or np.isnan(lat[j]) else float(lat[j]),
+            )
             for j in np.flatnonzero(row > 0)
         }
 

@@ -30,17 +30,29 @@ from kaboodle_tpu.sim.state import MeshState
 _FORMAT_VERSION = 1
 
 
+def _optional_fields() -> set[str]:
+    """MeshState fields that may be ``None`` (default-None dataclass fields)."""
+    return {f.name for f in dataclasses.fields(MeshState) if f.default is None}
+
+
 def save(path, state: MeshState) -> None:
-    """Write ``state`` to ``path`` (.npz), host-fetching device arrays."""
+    """Write ``state`` to ``path`` (.npz), host-fetching device arrays.
+
+    Optional fields that are ``None`` (the memory-lean ``track_latency=False``
+    / ``instant_identity=True`` states) are simply absent from the archive —
+    never pickled as object arrays, which ``load`` could not read back."""
     arrays = {
-        f.name: np.asarray(getattr(state, f.name)) for f in dataclasses.fields(state)
+        f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(state)
+        if getattr(state, f.name) is not None
     }
     np.savez(path, __version__=np.int32(_FORMAT_VERSION), **arrays)
 
 
 def load(path, mesh=None) -> MeshState:
     """Read a checkpoint; with ``mesh`` set, place rows across its devices
-    (the layout kaboodle_tpu.parallel.shard_state would give a fresh state)."""
+    (the layout kaboodle_tpu.parallel.shard_state would give a fresh state).
+    Optional fields absent from the archive restore as ``None``."""
     with np.load(path) as z:
         if "__version__" not in z.files:
             raise KaboodleError("not a kaboodle checkpoint (no version entry)")
@@ -48,10 +60,15 @@ def load(path, mesh=None) -> MeshState:
         if version != _FORMAT_VERSION:
             raise KaboodleError(f"unsupported checkpoint version {version}")
         fields = {f.name for f in dataclasses.fields(MeshState)}
-        missing = fields - set(z.files)
+        missing = fields - set(z.files) - _optional_fields()
         if missing:
             raise KaboodleError(f"checkpoint missing fields: {sorted(missing)}")
-        state = MeshState(**{name: jnp.asarray(z[name]) for name in fields})
+        state = MeshState(
+            **{
+                name: jnp.asarray(z[name]) if name in z.files else None
+                for name in fields
+            }
+        )
     if mesh is not None:
         from kaboodle_tpu.parallel import shard_state
 

@@ -91,11 +91,16 @@ def format_peer_table(self_addr: str, peer_states: dict, peers: dict) -> str:
     """The per-second status block (main.rs:198-225)."""
     lines = []
     for addr in sorted(peer_states):
-        state, latency = peer_states[addr]
+        # Real-net entries are (state, latency-in-ms); sim entries are
+        # (state, tick, latency-in-ticks) — latency is last either way, but
+        # the unit differs (one tick = one protocol period = 1 s wall).
+        entry = peer_states[addr]
+        state, latency = entry[0], entry[-1]
+        unit = "tk" if len(entry) == 3 else "ms"
         ident = peers.get(addr, b"")
         ident_s = ident.decode("utf-8", "replace") if isinstance(ident, bytes) else str(ident)
         me = " (me)" if addr == self_addr else ""
-        lat = f"{latency:7.1f}ms" if isinstance(latency, (int, float)) else "        -"
+        lat = f"{latency:7.1f}{unit}" if isinstance(latency, (int, float)) else "        -"
         lines.append(f"  {addr:<28} {state:<22} {lat}  {ident_s}{me}")
     return "\n".join(lines)
 

@@ -168,6 +168,12 @@ class PeerEngine:
         self._rng = random.Random(seed)
         # D2: sync candidates observed this tick, in arrival order.
         self._sync_candidates: list[tuple[object, int, int]] = []
+        # D5 bookkeeping (lockstep only): the membership snapshot at the start
+        # of the current broadcast round and the joins accepted during it.
+        # None => standalone use (real transport), where the trim falls back
+        # to the whole-map rule.
+        self._round_base: Optional[set] = None
+        self._round_joins: list = []
 
     # --- queries (lib.rs:301-354) -------------------------------------------
 
@@ -220,8 +226,26 @@ class PeerEngine:
         cap = self.cfg.max_share_peers
         if cap and len(entries) > cap:
             if self.cfg.deterministic:
-                entries.sort(key=lambda e: addr_key(e[0]))
-                entries = entries[:cap]
+                if self._round_base is None:
+                    # Standalone use: cap to the lowest-addressed entries.
+                    entries.sort(key=lambda e: addr_key(e[0]))
+                    entries = entries[:cap]
+                else:
+                    # D5 (aligned with the kernel): cap to the lowest-index
+                    # members of the start-of-round map, plus — uncapped —
+                    # every joiner accepted so far this round (the kernel's
+                    # term2 adds this round's Join origins outside the cap).
+                    base = sorted(
+                        (e for e in entries if e[0] in self._round_base),
+                        key=lambda e: addr_key(e[0]),
+                    )[:cap]
+                    base_addrs = {a for a, _ in base}
+                    joins = set(self._round_joins)
+                    extra = [
+                        e for e in entries
+                        if e[0] in joins and e[0] not in base_addrs
+                    ]
+                    entries = base + extra
             else:
                 entries = self._rng.sample(entries, cap)
         return entries
@@ -257,7 +281,11 @@ class PeerEngine:
 
     def _maybe_broadcast_join(self, now: float, out: Outbox) -> None:
         """kaboodle.rs:228-251: first call always broadcasts; afterwards only
-        while lonely and >= REBROADCAST_INTERVAL since the last broadcast."""
+        while lonely and >= REBROADCAST_INTERVAL since the last broadcast.
+        With ``join_broadcast_enabled=False`` (the gossip boot — no broadcast
+        medium) the whole mechanism is disabled."""
+        if not self.cfg.join_broadcast_enabled:
+            return
         if self.last_broadcast_time is not None:
             lonely = len(self.known) <= 1
             waited = (now - self.last_broadcast_time) >= self.cfg.rebroadcast_interval_ticks
@@ -409,6 +437,11 @@ class PeerEngine:
             is_new = prev is None
             latency = prev.latency if prev else None  # kaboodle.rs:291-297
             self.known[msg.addr] = PeerRecord(msg.identity, KNOWN, now, latency)
+            if self._round_base is not None:
+                # D5 bookkeeping only under the lockstep harness (which resets
+                # both fields every round); a standalone engine must not
+                # accumulate join addresses forever.
+                self._round_joins.append(msg.addr)
             if is_new and self._should_respond_to_broadcast():
                 share = self._share_snapshot_join()
                 if share:

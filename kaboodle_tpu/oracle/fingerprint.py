@@ -13,7 +13,7 @@
 from __future__ import annotations
 
 import zlib
-from typing import Callable, Iterable, Mapping
+from typing import Callable, Mapping
 
 _M1 = 0x7FEB352D
 _M2 = 0x846CA68B

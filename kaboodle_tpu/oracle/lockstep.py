@@ -66,6 +66,7 @@ class LockstepMesh:
         delivery_ok: Optional[Callable[[int, int, int], bool]] = None,
         seed: int = 0,
         alive: Optional[list[bool]] = None,
+        ring_contacts: int = 0,
     ) -> None:
         self.n = n
         self.cfg = cfg or SwimConfig()
@@ -79,6 +80,18 @@ class LockstepMesh:
             PeerEngine(i, self.identities[i], self.cfg, now=0, seed=seed * 100003 + i)
             for i in range(n)
         ]
+        # Gossip-boot seed contacts (init_state(ring_contacts=...) twin): peer
+        # i additionally knows peers (i+1..i+c) mod n as Known(0).
+        if ring_contacts:
+            if ring_contacts >= n:
+                raise ValueError("ring_contacts must be < n")
+            from kaboodle_tpu.oracle.engine import PeerRecord
+            from kaboodle_tpu.spec import KNOWN
+
+            for i, eng in enumerate(self.engines):
+                for d in range(1, ring_contacts + 1):
+                    j = (i + d) % n
+                    eng.known[j] = PeerRecord(self.identities[j], KNOWN, 0)
         # Message log of the current tick, for tests/metrics.
         self.last_tick_messages = 0
 
@@ -168,7 +181,12 @@ class LockstepMesh:
             broadcasts.extend((i, b) for b in out.broadcasts)
             round1.extend((i, d, m) for d, m in out.unicasts)
 
-        # B: broadcast delivery; join responses land with round 2.
+        # B: broadcast delivery; join responses land with round 2. Each
+        # engine's D5 snapshot (start-of-round membership + joins accepted so
+        # far) is what the aligned share-cap trims against.
+        for eng in self.engines:
+            eng._round_base = set(eng.known)
+            eng._round_joins = []
         join_responses = self._deliver_broadcasts(broadcasts, now)
 
         # C..F: four unicast delivery rounds resolve the ping / ping-req /

@@ -93,11 +93,19 @@ def make_multihost_mesh(
     return Mesh(np.asarray(jax.devices()), (PEER_AXIS,))
 
 
-def state_specs() -> MeshState:
-    """PartitionSpecs for MeshState: row axis sharded, control scalars replicated."""
+def state_specs(state: MeshState | None = None) -> MeshState:
+    """PartitionSpecs for MeshState: row axis sharded, control scalars replicated.
+
+    The optional ``[N, N]`` fields (``latency``, ``id_view``) get specs only
+    when present in ``state`` — a ``None`` leaf is an *empty subtree* in a
+    pytree, so the spec tree's structure must mirror the state's exactly or
+    every tree-mapped placement/constraint raises. With no ``state`` given,
+    both optional fields are assumed present (the default ``init_state``)."""
     row2 = P(PEER_AXIS, None)
     row1 = P(PEER_AXIS)
     rep = P()
+    has_latency = state.latency is not None if state is not None else True
+    has_id_view = state.id_view is not None if state is not None else True
     return MeshState(
         state=row2,
         timer=row2,
@@ -110,6 +118,8 @@ def state_specs() -> MeshState:
         kpr_n=row1,
         tick=rep,
         key=rep,
+        latency=row2 if has_latency else None,
+        id_view=row2 if has_id_view else None,
     )
 
 
@@ -143,7 +153,7 @@ def _check_divisible(n: int, mesh: Mesh) -> None:
 def shard_state(state: MeshState, mesh: Mesh) -> MeshState:
     """Place a MeshState on the mesh (row axis split across ``peers``)."""
     _check_divisible(state.state.shape[0], mesh)
-    return jax.device_put(state, _named(mesh, state_specs()))
+    return jax.device_put(state, _named(mesh, state_specs(state)))
 
 
 def shard_inputs(inputs: TickInputs, mesh: Mesh, stacked: bool = False) -> TickInputs:
@@ -159,10 +169,12 @@ def make_sharded_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
     The constraint after every tick keeps the scan carry's sharding fixed, so
     XLA partitions each tick identically instead of re-deciding layouts."""
     tick = make_tick_fn(cfg, faulty=faulty)
-    shardings = _named(mesh, state_specs())
 
     def sharded_tick(st: MeshState, inp: TickInputs):
         st, m = tick(st, inp)
+        # Specs derived from the (traced) carry itself, so the optional fields'
+        # presence — static at trace time — always matches the tree structure.
+        shardings = _named(mesh, state_specs(st))
         st = jax.tree.map(jax.lax.with_sharding_constraint, st, shardings)
         return st, m
 

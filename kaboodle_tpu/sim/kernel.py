@@ -542,7 +542,7 @@ def make_tick_fn(
         S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
         T = jnp.where(mark_rep, t, T)
 
-        def _kpr_reply_insert(S, T):
+        def _kpr_reply_insert(S, T, idv):
             share_f = (S_share == KNOWN) & ~eye & (
                 (t - T_share) < cfg.max_peer_share_age_ticks
             )
@@ -550,15 +550,25 @@ def make_tick_fn(
             rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
             S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
             T2 = jnp.where(rep_ins, t - cfg.max_peer_share_age_ticks, T)
-            return S2, T2
+            if has_idv:
+                # The reply carries (addr, identity) records (structs.rs:110);
+                # identity words resolve to the peers' current identities
+                # (D-ID1, like the join-gossip insert above). Without this, a
+                # row re-filled after a revive keeps placeholder words and its
+                # fingerprint can never agree.
+                idv = jnp.where(rep_ins, id_row, idv)
+            return S2, T2, idv
 
-        S, T = jax.lax.cond(
-            jnp.any(del_rep), _kpr_reply_insert, lambda S, T: (S, T), S, T
+        S, T, idv = jax.lax.cond(
+            jnp.any(del_rep),
+            _kpr_reply_insert,
+            lambda S, T, idv: (S, T, idv),
+            S, T, idv,
         )
 
         # ================= metrics + next state ===============================
         member_f = S > 0
-        fp_f, n_f = _fingerprint_and_count(member_f, rec_hash)
+        fp_f, n_f = fp_count(member_f, idv)
         fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
         fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
         n_alive = jnp.sum(alive, dtype=jnp.int32)
@@ -592,6 +602,8 @@ def make_tick_fn(
             kpr_n=n_g,
             tick=t + 1,
             key=key_next,
+            latency=lat,
+            id_view=idv,
         )
         metrics = TickMetrics(
             messages_delivered=msgs,

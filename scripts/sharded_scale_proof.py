@@ -48,10 +48,17 @@ def main() -> None:
     from kaboodle_tpu.sim.scenario import all_fault_paths_scenario
     from kaboodle_tpu.sim.state import init_state
 
+    from bench import LEAN_STATE_MIN_N
+
     n, ticks = args.n, args.ticks
     mesh = make_mesh(args.devices)
     cfg = SwimConfig()
-    st = shard_state(init_state(n, seed=0), mesh)
+    # MEMORY_PLAN.md policy: large N automatically selects the memory-lean
+    # state (no latency EWMA / instant identity) — same rule as bench.py.
+    lean = n >= LEAN_STATE_MIN_N
+    st = shard_state(
+        init_state(n, seed=0, track_latency=not lean, instant_identity=lean), mesh
+    )
 
     # Same every-fault-path schedule the driver dry run validates, at scale.
     inp = shard_inputs(
@@ -90,6 +97,7 @@ def main() -> None:
         "peak_rss_mib": round(peak_rss_mib, 1),
         "backend": jax.default_backend(),
         "faulty": True,
+        "state_variant": "lean" if lean else "full",
     }
     print(json.dumps(line))
 

@@ -32,7 +32,7 @@ def test_2x2_demo_converges_with_matching_fingerprints():
     for k in nodes:
         assert k.peers() == {i: IDENTITIES[i] for i in range(4)}
         states = k.peer_states()
-        assert all(s == "Known" for s, _ in states.values())
+        assert all(s == "Known" for s, _, _ in states.values())
 
 
 def test_lifecycle_guards():
@@ -178,6 +178,39 @@ def test_convergence_timeout_raises():
     net.set_drop_rate(1.0)  # nothing can ever be delivered
     with pytest.raises(ConvergenceTimeout):
         net.tick_until_converged(max_ticks=4)
+
+
+def test_peer_states_surfaces_latency_ewma():
+    """After a few ticks of traffic, the per-peer latency EWMA is a real
+    number (kaboodle.rs:789-817 surfaced via lib.rs:348-354). Self has no
+    samples (a peer never pings itself) and reports None."""
+    net, nodes = _demo_mesh()
+    net.tick(8)
+    sampled = [
+        lat
+        for k in nodes
+        for j, (_, _, lat) in k.peer_states().items()
+        if j != k.self_addr()
+    ]
+    assert sampled and any(lat is not None for lat in sampled)
+    for lat in sampled:
+        assert lat is None or lat >= 0.0
+    for k in nodes:
+        assert k.peer_states()[k.self_addr()][2] is None
+
+
+def test_discover_mesh_member_probe_without_joining():
+    """The standalone probe (discovery.rs:30-89, lib.rs:359-368): find one
+    running member + identity without attaching an instance."""
+    net, nodes = _demo_mesh()
+    net.tick(2)
+    addr, ident = net.discover_mesh_member()
+    assert addr in {k.self_addr() for k in nodes if k.is_running}
+    assert ident == IDENTITIES[addr]
+    empty = SimNetwork(capacity=2)
+    Kaboodle(empty, b"idle")  # attached but never started
+    with pytest.raises(InvalidOperation):
+        empty.discover_mesh_member()
 
 
 def test_explicit_revive_survives_churn_composition():

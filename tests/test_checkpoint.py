@@ -15,7 +15,11 @@ def _states_equal(a, b):
     import dataclasses
 
     for f in dataclasses.fields(a):
-        assert jnp.array_equal(getattr(a, f.name), getattr(b, f.name)), f.name
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if va is None or vb is None:
+            assert va is None and vb is None, f.name
+        else:
+            assert jnp.array_equal(va, vb, equal_nan=True), f.name
 
 
 def test_resume_is_bit_exact(tmp_path):
@@ -40,6 +44,37 @@ def test_load_onto_mesh(tmp_path):
     path = tmp_path / "mesh.npz"
     checkpoint.save(path, st)
     sharded = checkpoint.load(path, mesh=mesh)
+    assert len(sharded.state.sharding.device_set) == 8
+    _states_equal(st, sharded)
+
+
+def test_lean_state_roundtrip(tmp_path):
+    """The memory-lean state (track_latency=False, instant_identity=True) —
+    what the 65k-peer configs run — must roundtrip with its optional fields
+    restored as None, and resume bit-exactly."""
+    n, cfg = 16, SwimConfig()
+    st = init_state(n, seed=5, track_latency=False, instant_identity=True)
+    mid, _ = simulate(st, idle_inputs(n, ticks=5), cfg)
+    unbroken, _ = simulate(mid, idle_inputs(n, ticks=5), cfg)
+
+    path = tmp_path / "lean.npz"
+    checkpoint.save(path, mid)
+    resumed_mid = checkpoint.load(path)
+    assert resumed_mid.latency is None and resumed_mid.id_view is None
+    _states_equal(mid, resumed_mid)
+    resumed, _ = simulate(resumed_mid, idle_inputs(n, ticks=5), cfg)
+    _states_equal(unbroken, resumed)
+
+
+def test_lean_load_onto_mesh(tmp_path):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = make_mesh(8)
+    st = init_state(32, seed=4, track_latency=False, instant_identity=True)
+    path = tmp_path / "lean_mesh.npz"
+    checkpoint.save(path, st)
+    sharded = checkpoint.load(path, mesh=mesh)
+    assert sharded.latency is None and sharded.id_view is None
     assert len(sharded.state.sharding.device_set) == 8
     _states_equal(st, sharded)
 

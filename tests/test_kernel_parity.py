@@ -206,6 +206,140 @@ def test_intended_failed_broadcast_parity():
     _run_parity(mesh, st, plan, cfg=cfg)
 
 
+def test_gossip_boot_parity():
+    """Gossip boot (join_broadcast_enabled=False + ring seed contacts):
+    membership spreads only via pings + anti-entropy pulls
+    (kaboodle.rs:707-740) — no broadcast medium. Exact per-tick parity."""
+    cfg = SwimConfig(deterministic=True, join_broadcast_enabled=False)
+    mesh = LockstepMesh(N, cfg, ring_contacts=2)
+    st = init_state(N, ring_contacts=2)
+    _run_parity(mesh, st, [_inputs(N) for _ in range(24)], cfg=cfg)
+
+
+def test_gossip_boot_churn_parity():
+    """Gossip boot under churn: a silent kill must still be detected and
+    removed with no broadcast path anywhere."""
+    cfg = SwimConfig(deterministic=True, join_broadcast_enabled=False)
+    mesh = LockstepMesh(N, cfg, ring_contacts=2)
+    st = init_state(N, ring_contacts=2)
+    plan = []
+    for i in range(20):
+        kill = np.zeros(N, bool)
+        if i == 6:
+            kill[4] = True
+        plan.append(_inputs(N, kill=kill))
+    _run_parity(mesh, st, plan, cfg=cfg)
+
+
+def test_share_cap_parity():
+    """D5: the join-response share cap (kernel.py share_base branch; the
+    reference's 10 KiB trim, kaboodle.rs:373-383). An isolated peer joins
+    late through a single reachable responder, so its bootstrap map is
+    exactly that responder's capped share — observably different from the
+    uncapped path."""
+    n = 24
+    cfg = SwimConfig(deterministic=True, max_share_peers=8)
+    mesh = LockstepMesh(n, cfg)
+    st = init_state(n)
+
+    loner = n - 1
+    # Ticks 0-9: loner fully isolated; the rest converge among themselves.
+    iso = np.ones((n, n), bool)
+    iso[loner, :] = False
+    iso[:, loner] = False
+    iso[loner, loner] = True
+    # Tick 10 (the loner's lonely re-broadcast, rebroadcast_interval_ticks):
+    # only the loner<->0 edges are up, so peer 0 is the sole join responder.
+    one_edge = iso.copy()
+    one_edge[loner, 0] = True
+    one_edge[0, loner] = True
+    plan = [_inputs(n, drop_ok=iso) for _ in range(10)]
+    plan.append(_inputs(n, drop_ok=one_edge))
+    st = _run_parity(mesh, st, plan, cfg=cfg)
+
+    # The capped share really was capped: right after the join tick the loner
+    # knows exactly itself plus the 8 lowest-index members of responder 0's
+    # map (peers 0..7, responder included) — the uncapped path would have
+    # given it all 24.
+    row = np.asarray(st.state)[loner] > 0
+    assert set(np.flatnonzero(row)) == set(range(8)) | {loner}
+
+    # A few fully-open ticks after: parity continues to hold while the loner
+    # refills via pings + anti-entropy pulls.
+    _run_parity(mesh, st, [_inputs(n) for _ in range(4)], cfg=cfg)
+
+
+def test_share_cap_inactive_at_small_n_parity():
+    """With the cap above N the cap branch compiles out and boot parity
+    still holds — guards the static `n > cap` gate itself."""
+    cfg = SwimConfig(deterministic=True, max_share_peers=16)
+    mesh = LockstepMesh(N, cfg)
+    st = init_state(N)
+    _run_parity(mesh, st, [_inputs(N) for _ in range(6)], cfg=cfg)
+
+
+@pytest.mark.slow
+def test_large_n_trajectory_parity():
+    """N=256 trajectory check (VERDICT r2 item 5): per-tick fingerprints and
+    membership counts against the oracle, broadcast boot with an active share
+    cap (16 < N) so the D5 path runs at scale."""
+    n, ticks = 256, 4
+    cfg = SwimConfig(deterministic=True, max_share_peers=16)
+    mesh = LockstepMesh(n, cfg)
+    st = init_state(n)
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=False))
+    from kaboodle_tpu.ops.hashing import membership_fingerprint
+
+    inp = TickInputs(
+        kill=jnp.zeros(n, bool), revive=jnp.zeros(n, bool),
+        partition=jnp.zeros(n, jnp.int32), drop_rate=jnp.float32(0),
+        manual_target=jnp.full(n, -1, jnp.int32), drop_ok=None,
+    )
+    for t in range(ticks):
+        mesh.tick()
+        st, m = tick_fn(st, inp)
+        kfp = np.asarray(
+            membership_fingerprint(st.state > 0, st.identity), dtype=np.uint64
+        )
+        ofp = np.array(mesh.fingerprints(), dtype=np.uint64) & 0xFFFFFFFF
+        np.testing.assert_array_equal(kfp, ofp, err_msg=f"fingerprints at tick {t}")
+        kcount = np.asarray((np.asarray(st.state) > 0).sum(axis=1))
+        ocount = np.array([e.num_peers() for e in mesh.engines])
+        np.testing.assert_array_equal(kcount, ocount, err_msg=f"counts at tick {t}")
+        assert bool(m.converged) == mesh.converged(), f"convergence at tick {t}"
+
+
+def test_id_view_refreshed_on_anti_entropy_insert():
+    """Regression: a row re-filled via a KnownPeersRequest reply must adopt
+    real identity words in ``id_view``, not keep the revive-reset placeholder
+    zeros — otherwise its id_view fingerprint (the kernel's convergence
+    metric) can never agree with the mesh."""
+    n = 16
+    cfg = SwimConfig()
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
+    st = init_state(n, seed=2)
+    idle = _inputs(n)
+    idle = TickInputs(kill=idle.kill, revive=idle.revive, partition=idle.partition,
+                      drop_rate=idle.drop_rate, manual_target=idle.manual_target,
+                      drop_ok=None)
+    m = None
+    for t in range(48):
+        kill = jnp.zeros(n, bool).at[3].set(t == 2)
+        revive = jnp.zeros(n, bool).at[3].set(t == 8)
+        inp = TickInputs(kill=kill, revive=revive, partition=idle.partition,
+                         drop_rate=idle.drop_rate, manual_target=idle.manual_target,
+                         drop_ok=None)
+        st, m = tick_fn(st, inp)
+    assert bool(m.converged), "mesh never re-converged after revive"
+    member = np.asarray(st.state) > 0
+    idv = np.asarray(st.id_view)
+    ident = np.asarray(st.identity)
+    # Every member entry's identity view matches the true identity word.
+    np.testing.assert_array_equal(
+        np.where(member, idv, 0), np.where(member, ident[None, :], 0)
+    )
+
+
 def test_manual_self_ping_dropped():
     """D8: manual self-pings are dropped at the transport in both engines."""
     mesh = LockstepMesh(N, CFG)

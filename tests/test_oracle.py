@@ -14,7 +14,6 @@ from kaboodle_tpu.oracle import (
     PeerEngine,
     Ping,
     PingRequest,
-    mix_fingerprint,
 )
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
 

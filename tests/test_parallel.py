@@ -37,6 +37,11 @@ def _assert_states_equal(a, b):
     for name in ("state", "timer", "alive", "never_broadcast", "last_broadcast",
                  "kpr_partner", "kpr_fp", "kpr_n", "tick"):
         assert jnp.array_equal(getattr(a, name), getattr(b, name)), name
+    for name in ("latency", "id_view"):
+        va, vb = getattr(a, name), getattr(b, name)
+        assert (va is None) == (vb is None), name
+        if va is not None:
+            assert jnp.array_equal(va, vb, equal_nan=True), name
 
 
 @pytest.mark.parametrize("deterministic", [True, False])
@@ -57,6 +62,39 @@ def test_sharded_simulate_matches_single_device(mesh8, deterministic):
     assert jnp.array_equal(ref_m.messages_delivered, sh_m.messages_delivered)
     assert jnp.array_equal(ref_m.fingerprint_min, sh_m.fingerprint_min)
     assert jnp.array_equal(ref_m.fingerprint_max, sh_m.fingerprint_max)
+
+
+@pytest.mark.parametrize("track_latency", [True, False])
+@pytest.mark.parametrize("instant_identity", [True, False])
+def test_sharded_optional_fields_all_combinations(mesh8, track_latency, instant_identity):
+    """The optional [N, N] fields (latency, id_view) must shard as
+    P('peers', None) when present and stay None when absent — in all four
+    combinations the sharded trajectory equals the single-device one."""
+    n, ticks = 16, 8
+    cfg = SwimConfig()
+    st = init_state(n, seed=9, track_latency=track_latency,
+                    instant_identity=instant_identity)
+    inp = idle_inputs(n, ticks=ticks)
+
+    ref_final, _ = simulate(st, inp, cfg, faulty=False)
+
+    st_sh = shard_state(st, mesh8)
+    row_sharded = NamedSharding(mesh8, P(PEER_AXIS, None))
+    if track_latency:
+        assert st_sh.latency.sharding.is_equivalent_to(row_sharded, st_sh.latency.ndim)
+    else:
+        assert st_sh.latency is None
+    if instant_identity:
+        assert st_sh.id_view is None
+    else:
+        assert st_sh.id_view.sharding.is_equivalent_to(row_sharded, st_sh.id_view.ndim)
+
+    sh_final, _ = simulate_sharded(
+        st_sh, shard_inputs(inp, mesh8, stacked=True), cfg, mesh8, faulty=False
+    )
+    _assert_states_equal(ref_final, sh_final)
+    if track_latency:
+        assert sh_final.latency.sharding.is_equivalent_to(row_sharded, 2)
 
 
 def test_sharded_faulty_path_matches_single_device(mesh8):

@@ -237,6 +237,19 @@ def _accelerator_responsive(
     return False
 
 
+def _pin_cpu() -> None:
+    """Pin JAX to the CPU backend. Env alone is not enough: the interpreter's
+    sitecustomize may have imported jax already with a pinned platform —
+    update the live config too (backends are created lazily; same pattern as
+    tests/conftest.py)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
     import os
 
@@ -249,7 +262,14 @@ def main() -> None:
                    help="skip the gossip-boot convergence sweep")
     p.add_argument("--gossip-sizes", type=int, nargs="*", default=None,
                    help="peer counts for the gossip-boot sweep (default: by platform)")
+    p.add_argument("--platform", choices=["cpu"], default=None,
+                   help="pin the JAX platform (skips the probe; 'cpu' avoids "
+                        "touching a possibly-wedged accelerator plugin)")
     args = p.parse_args()
+
+    if args.platform == "cpu":
+        _pin_cpu()
+        args.no_probe = True
 
     # The probe costs one extra backend init, so skip it when the platform is
     # already pinned to CPU (nothing to hang) or explicitly disabled.
@@ -258,15 +278,8 @@ def main() -> None:
     if fallback:
         print("bench: accelerator unresponsive; falling back to CPU backend",
               file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        # The environment alone is not enough here: sitecustomize may already
-        # have imported jax and pinned the platform, so update the live config
-        # too (backends are created lazily; see tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        import jax
+        _pin_cpu()
+    import jax
 
     backend = jax.default_backend()
     n_chips = jax.device_count()

@@ -412,7 +412,15 @@ def make_tick_fn(
             )
 
         member_2 = S > 0
-        fp2, n2 = fp_count(member_2, idv)
+        # fp2/n2 feed only the indirect-ping ack payloads (call-3 acks at
+        # proxies, call-4 forwards) — every consumer is masked by an
+        # escalation-derived delivery, so the whole O(N^2) hash pass is gated
+        # off on escalation-free ticks (all of fault-free steady state).
+        fp2, n2 = jax.lax.cond(
+            jnp.any(escalate),
+            lambda: fp_count(member_2, idv),
+            lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
+        )
 
         # Queued: the suspect's Acks back to the proxies.
         del_pack = del_pping & _gather_edge(ok, jstar[:, None], proxies)  # [N, k]
@@ -431,32 +439,46 @@ def make_tick_fn(
         fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
         del_fwd_c = fwd_c & _gather_edge(ok, proxies, idx[:, None])  # p -> suspector
 
-        # ================= Call 3: suspect Acks at proxies ====================
-        mark3 = jnp.zeros((n, n), dtype=bool)
-        mark3 = _scatter_or(
-            mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
-        )  # proxy marks suspect — the proxy's own view resurrects (Q1)
-        mark3 = _scatter_or(mark3, idx[:, None], proxies, del_fwd_c)  # suspector marks pinger-proxy
-        S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
-
-        # Proxy forwards the suspect's Ack (fp2 payload) unless the curious
-        # entry was already popped by the call-2 coincidence.
+        # Proxy forwards the suspect's Ack (fp2 payload) in call 4 unless the
+        # curious entry was already popped by the call-2 coincidence.
         fwd = del_pack & ~pop_hit
         del_fwd = fwd & _gather_edge(ok, proxies, idx[:, None])  # [N, k] p -> suspector
 
-        # ================= Call 4: forwarded Acks =============================
-        # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is the
-        # proxy, so the suspector marks the proxy — the suspect stays
-        # WaitingForIndirectPing (kaboodle.rs:408-415 applies to the sender).
-        mark4 = jnp.zeros((n, n), dtype=bool)
-        mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
-        S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
-        if not cfg.faithful_indirect_ack:
-            # Intended-SWIM mode: a forwarded ack clears the suspect too.
-            cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
-            clr_cell = cleared[:, None] & jstar_cell & (S > 0)
-            S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
-            T = jnp.where(clr_cell, t, T)
+        # ============ Calls 3 + 4: escalation-only delivery waves =============
+        # Call 3: suspect Acks at proxies; call 4: forwarded Acks. Every
+        # datagram in these waves descends from an escalation this tick, so
+        # the mark scatters and full-matrix where-passes are gated out of
+        # escalation-free ticks (all of fault-free steady state).
+        def _calls34(S, T, lat, idv):
+            mark3 = jnp.zeros((n, n), dtype=bool)
+            mark3 = _scatter_or(
+                mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
+            )  # proxy marks suspect — the proxy's own view resurrects (Q1)
+            mark3 = _scatter_or(
+                mark3, idx[:, None], proxies, del_fwd_c
+            )  # suspector marks pinger-proxy
+            S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
+
+            # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is the
+            # proxy, so the suspector marks the proxy — the suspect stays
+            # WaitingForIndirectPing (kaboodle.rs:408-415 applies to the sender).
+            mark4 = jnp.zeros((n, n), dtype=bool)
+            mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
+            S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
+            if not cfg.faithful_indirect_ack:
+                # Intended-SWIM mode: a forwarded ack clears the suspect too.
+                cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
+                clr_cell = cleared[:, None] & jstar_cell & (S > 0)
+                S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
+                T = jnp.where(clr_cell, t, T)
+            return S, T, lat, idv
+
+        S, T, lat, idv = jax.lax.cond(
+            jnp.any(escalate),
+            _calls34,
+            lambda S, T, lat, idv: (S, T, lat, idv),
+            S, T, lat, idv,
+        )
 
         # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
         member_g = S > 0

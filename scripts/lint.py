@@ -59,6 +59,10 @@ def _collect_defined(tree: ast.AST) -> tuple[set, dict]:
             defined.add(node.name)
         elif isinstance(node, (ast.Global, ast.Nonlocal)):
             defined.update(node.names)
+        elif isinstance(node, (ast.MatchAs, ast.MatchStar)) and node.name:
+            defined.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            defined.add(node.rest)
     return defined, imports
 
 

@@ -19,7 +19,11 @@ from kaboodle_tpu.ops.hashing import membership_fingerprint
 from kaboodle_tpu.sim import Scenario, init_state, simulate
 from kaboodle_tpu.spec import KNOWN
 
-SETTINGS = dict(max_examples=12, deadline=None,
+# derandomize: the example stream is fixed per test body, so CI is
+# reproducible — a failure at HEAD is a failure on every run of HEAD, never a
+# seed lottery. Widen the net when hunting: run with
+# ``--hypothesis-seed=random`` and a higher max_examples locally.
+SETTINGS = dict(max_examples=12, deadline=None, derandomize=True,
                 suppress_health_check=[hypothesis.HealthCheck.too_slow])
 
 

@@ -55,7 +55,10 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
     from kaboodle_tpu.sim.runner import run_until_converged, simulate
     from kaboodle_tpu.sim.state import idle_inputs, init_state
 
-    cfg = SwimConfig()
+    # Fused Pallas fingerprint pass on the single-chip TPU path (the GSPMD
+    # path keeps the jnp formulation — see SwimConfig.use_pallas_fp).
+    use_pallas = jax.default_backend() == "tpu" and not sharded and n % 128 == 0
+    cfg = SwimConfig(use_pallas_fp=use_pallas)
     lean = n >= LEAN_STATE_MIN_N
     st = init_state(n, seed=0, track_latency=not lean, instant_identity=lean)
     rtt = _null_rtt()
@@ -136,6 +139,7 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
         "peers_ticks_per_sec": n * ticks / elapsed,
         "null_rtt_s": rtt,
         "state_variant": "lean" if lean else "full",
+        "pallas_fp": use_pallas,
         "peak_hbm_mib": _peak_device_memory_mib(),
     }
 
@@ -338,6 +342,7 @@ def main() -> None:
         "sharded": sharded,
         "backend": backend + (" (fallback: accelerator unresponsive)" if fallback else ""),
         "state_variant": result["state_variant"],
+        "pallas_fp": result["pallas_fp"],
         "converged": result["converged"],
         "ticks_to_convergence_broadcast_boot": result["ticks_to_convergence"],
         "convergence_wall_s": round(result["convergence_wall_s"], 4),

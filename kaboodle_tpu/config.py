@@ -80,6 +80,13 @@ class SwimConfig:
     # seeds ChaChaRng from entropy (kaboodle.rs:164) so exact-sequence parity
     # with Rust is a non-goal (SURVEY.md §7).
     deterministic: bool = False
+    # Compute the per-tick fingerprint/count reductions with the fused Pallas
+    # kernel (ops/fused_fp.py) instead of the jnp formulation — bit-exact,
+    # one guaranteed HBM pass, no [N, N] intermediates. Single-device only
+    # (the GSPMD path keeps the jnp form, which XLA partitions row-locally);
+    # requires N % 128 == 0. Off TPU it runs in pallas interpreter mode
+    # (correct but slow) — bench.py enables it on the single-chip TPU path.
+    use_pallas_fp: bool = False
 
     def __post_init__(self) -> None:
         if self.ping_timeout_ticks < 1:

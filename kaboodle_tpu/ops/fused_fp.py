@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: fused membership fingerprint + count row-reduction.
+
+The hottest per-tick op at large N is the fingerprint pass (kernel.py
+``fp_count``): read ``state`` int8 ``[N, N]``, mask, hash, and row-reduce.
+Expressed in jnp that is a compare (int8 -> bool), a hash over a ``[N, N]``
+uint32 tensor (id-view mode), a select, and a sum — XLA fuses most of it, but
+this kernel guarantees ONE pass over HBM with everything (member test, record
+hash, masked add, count) done in VMEM per tile, and no ``[N, N]``
+intermediates at all:
+
+    fp[i]    = sum_{j : state[i,j] > 0} mix32(mix32(j ^ GOLDEN) ^ id[i,j])
+    count[i] = |{j : state[i,j] > 0}|
+
+Bit-exact with :func:`kaboodle_tpu.ops.hashing.membership_fingerprint` over
+``state > 0`` (uint32 wraparound sums are associative and commutative, so the
+tiled accumulation order cannot change the result — asserted in
+tests/test_fused_fp.py).
+
+Used by the tick kernel when ``SwimConfig(use_pallas_fp=True)`` (bench
+enables it on the single-chip TPU path). Off TPU the op runs in pallas
+interpreter mode, so the flag is correct — just not fast — everywhere; the
+GSPMD sharded path keeps the jnp formulation, whose row-local reduction XLA
+partitions with no collectives.
+
+Reference anchor: the fingerprint this accelerates is the convergence signal
+of kaboodle.rs:71-83 (see ops/hashing.py for the commutative redesign).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kaboodle_tpu.ops.hashing import peer_record_hash
+
+# Per-input VMEM budget for the id-view block (bytes). Two inputs of this
+# size plus the int8 state block and temporaries stay well under the ~16 MiB
+# of VMEM even with double-buffered pipelining.
+_VMEM_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _kernel_idv(state_ref, idv_ref, fp_ref, cnt_ref):
+    member = state_ref[:] > 0
+    # The canonical record hash (ops.hashing) is plain jnp, so it runs inside
+    # the kernel body unchanged — one definition for both formulations.
+    pid = jax.lax.broadcasted_iota(jnp.uint32, idv_ref.shape, 1)
+    h = peer_record_hash(pid, idv_ref[:])
+    fp_ref[:] = jnp.sum(jnp.where(member, h, jnp.uint32(0)), axis=1, keepdims=True)
+    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
+
+
+def _kernel_hash(state_ref, hash_ref, fp_ref, cnt_ref):
+    member = state_ref[:] > 0
+    h = jnp.broadcast_to(hash_ref[:], member.shape)
+    fp_ref[:] = jnp.sum(jnp.where(member, h, jnp.uint32(0)), axis=1, keepdims=True)
+    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
+
+
+def _block_rows(n: int, bytes_per_cell: int) -> int:
+    rows = _VMEM_BLOCK_BYTES // max(n * bytes_per_cell, 1)
+    # Clamp to the int8 sublane tile (32) at the low end so state blocks stay
+    # tile-aligned, and keep the grid non-trivial at small N.
+    return int(max(32, min(rows, 512, n)))
+
+
+def pallas_supported(n: int) -> bool:
+    """Shapes the kernel handles: lane-aligned square state (N % 128 == 0)."""
+    return n % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_fp_count(
+    state: jax.Array,
+    identity: jax.Array,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Row fingerprints + membership counts of ``state`` in one fused pass.
+
+    Args:
+      state: int8 ``[N, N]`` spec state codes (member == code > 0).
+      identity: uint32 ``[N, N]`` per-row identity views (``MeshState.id_view``)
+        or uint32 ``[N]`` precomputed *record hashes* (the instant-identity
+        mode — NB: unlike membership_fingerprint this is the hashed vector,
+        exactly what the tick kernel precomputes as ``rec_hash``).
+      interpret: force pallas interpreter mode; default auto (True off-TPU).
+
+    Returns ``(fp uint32 [N], count int32 [N])``.
+    """
+    n = state.shape[-1]
+    if not pallas_supported(n):
+        raise ValueError(f"fused_fp_count needs N % 128 == 0, got {n}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    idv_mode = identity.ndim == 2
+    bn = _block_rows(n, 4 if idv_mode else 1)
+    grid = ((n + bn - 1) // bn,)
+    out_shape = (
+        jax.ShapeDtypeStruct((n, 1), jnp.uint32),
+        jax.ShapeDtypeStruct((n, 1), jnp.int32),
+    )
+    out_specs = (
+        pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    )
+    row_block = pl.BlockSpec((bn, n), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    if idv_mode:
+        fp, cnt = pl.pallas_call(
+            _kernel_idv,
+            grid=grid,
+            in_specs=[row_block, row_block],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(state, identity.astype(jnp.uint32))
+    else:
+        hash_block = pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM)
+        fp, cnt = pl.pallas_call(
+            _kernel_hash,
+            grid=grid,
+            in_specs=[row_block, hash_block],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(state, identity.astype(jnp.uint32)[None, :])
+    return fp[:, 0], cnt[:, 0]

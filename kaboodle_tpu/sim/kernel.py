@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 
 from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
 from kaboodle_tpu.ops.hashing import peer_record_hash
 from kaboodle_tpu.ops.sampling import (
     bernoulli_matrix,
@@ -157,12 +158,17 @@ def make_tick_fn(
         rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
         u_row = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (n, n))
 
-        def fp_count(member, idv_now):
+        def fp_count(S_now, idv_now):
             """Row fingerprints + membership counts at a point in the tick.
 
             With identity views, each row hashes the identities it has actually
             seen (engine.fingerprint() over its own records); otherwise the
-            global ``rec_hash`` vector (instant-identity fast mode)."""
+            global ``rec_hash`` vector (instant-identity fast mode). With
+            ``cfg.use_pallas_fp`` the whole pass (member test, hash, masked
+            sum, count) runs as one fused Pallas kernel — bit-exact."""
+            if cfg.use_pallas_fp and pallas_supported(n):
+                return fused_fp_count(S_now, idv_now if has_idv else rec_hash)
+            member = S_now > 0
             if has_idv:
                 contrib = jnp.where(member, peer_record_hash(u_row, idv_now), jnp.uint32(0))
             else:
@@ -373,8 +379,7 @@ def make_tick_fn(
         mark1 = _scatter_or(mark1, proxies, idx[:, None], del_pr)
         S, T, lat, idv = apply_marks(S, T, lat, idv, mark1)
 
-        member_1 = S > 0
-        fp1, n1 = fp_count(member_1, idv)
+        fp1, n1 = fp_count(S, idv)
 
         # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
         # proxies' Pings to the suspect (kaboodle.rs:533-545).
@@ -411,14 +416,14 @@ def make_tick_fn(
                 any_join, _gossip_insert, lambda S, T, idv: (S, T, idv), S, T, idv
             )
 
-        member_2 = S > 0
         # fp2/n2 feed only the indirect-ping ack payloads (call-3 acks at
         # proxies, call-4 forwards) — every consumer is masked by an
         # escalation-derived delivery, so the whole O(N^2) hash pass is gated
         # off on escalation-free ticks (all of fault-free steady state).
+        S_2 = S
         fp2, n2 = jax.lax.cond(
             jnp.any(escalate),
-            lambda: fp_count(member_2, idv),
+            lambda: fp_count(S_2, idv),
             lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
         )
 
@@ -481,8 +486,7 @@ def make_tick_fn(
         )
 
         # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
-        member_g = S > 0
-        fp_g, n_g = fp_count(member_g, idv)
+        fp_g, n_g = fp_count(S, idv)
 
         # Candidate priority = phase_base + sender index; first match wins
         # (take_sync_request scans in arrival order). Match condition:
@@ -589,8 +593,7 @@ def make_tick_fn(
         )
 
         # ================= metrics + next state ===============================
-        member_f = S > 0
-        fp_f, n_f = fp_count(member_f, idv)
+        fp_f, n_f = fp_count(S, idv)
         fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
         fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
         n_alive = jnp.sum(alive, dtype=jnp.int32)

@@ -1,0 +1,84 @@
+"""Fused Pallas fingerprint kernel: bit-exact vs the jnp formulation.
+
+Runs in pallas interpreter mode on the CPU test mesh (fused_fp_count
+auto-selects interpret off-TPU), so these tests pin semantics everywhere;
+TPU runs compile the same kernel for real.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
+from kaboodle_tpu.ops.hashing import membership_fingerprint, peer_record_hash
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import init_state, idle_inputs
+
+
+def _random_state(rng, n):
+    codes = rng.integers(0, 4, size=(n, n), dtype=np.int8)
+    np.fill_diagonal(codes, 1)
+    return jnp.asarray(codes)
+
+
+@pytest.mark.parametrize("n", [128, 256, 768])
+def test_fused_matches_jnp_id_view_mode(n):
+    # n=768 forces a multi-block grid (block rows cap at 512) with a
+    # partially out-of-bounds final block — the tiling/padding path every
+    # bench-scale run takes on real TPU.
+    rng = np.random.default_rng(7)
+    state = _random_state(rng, n)
+    idv = jnp.asarray(rng.integers(0, 2**32, size=(n, n), dtype=np.uint32))
+    fp, cnt = fused_fp_count(state, idv)
+    ref_fp = membership_fingerprint(state > 0, idv)
+    np.testing.assert_array_equal(np.asarray(fp), np.asarray(ref_fp))
+    np.testing.assert_array_equal(
+        np.asarray(cnt), np.asarray((state > 0).sum(axis=1, dtype=jnp.int32))
+    )
+
+
+@pytest.mark.parametrize("n", [128, 768])
+def test_fused_matches_jnp_hash_mode(n):
+    rng = np.random.default_rng(11)
+    state = _random_state(rng, n)
+    ident = jnp.asarray(rng.integers(0, 2**32, size=(n,), dtype=np.uint32))
+    rec_hash = peer_record_hash(jnp.arange(n, dtype=jnp.uint32), ident)
+    fp, cnt = fused_fp_count(state, rec_hash)
+    ref_fp = membership_fingerprint(state > 0, ident)
+    np.testing.assert_array_equal(np.asarray(fp), np.asarray(ref_fp))
+
+
+def test_unsupported_shape_raises():
+    state = jnp.zeros((100, 100), jnp.int8)
+    assert not pallas_supported(100)
+    with pytest.raises(ValueError):
+        fused_fp_count(state, jnp.zeros((100,), jnp.uint32))
+
+
+@pytest.mark.parametrize("lean", [False, True])
+def test_tick_kernel_identical_with_pallas_fp(lean):
+    """The whole tick trajectory is bit-identical with the fused pass on
+    (pallas, interpret mode here) and off — fingerprints are the convergence
+    signal, so any drift would change protocol behavior."""
+    n, ticks = 128, 4
+    st0 = init_state(n, seed=5, track_latency=not lean, instant_identity=lean)
+    inp = idle_inputs(n)
+    outs = {}
+    for flag in (False, True):
+        tick = jax.jit(make_tick_fn(SwimConfig(use_pallas_fp=flag), faulty=False))
+        st = st0
+        ms = []
+        for _ in range(ticks):
+            st, m = tick(st, inp)
+            ms.append(m)
+        outs[flag] = (st, ms)
+    a, b = outs[False], outs[True]
+    np.testing.assert_array_equal(np.asarray(a[0].state), np.asarray(b[0].state))
+    np.testing.assert_array_equal(np.asarray(a[0].timer), np.asarray(b[0].timer))
+    for ma, mb in zip(a[1], b[1]):
+        assert bool(ma.converged) == bool(mb.converged)
+        assert int(ma.fingerprint_min) == int(mb.fingerprint_min)
+        assert int(ma.fingerprint_max) == int(mb.fingerprint_max)
+        assert int(ma.messages_delivered) == int(mb.messages_delivered)

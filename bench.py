@@ -57,10 +57,21 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
 
     # Fused Pallas fingerprint pass on the single-chip TPU path (the GSPMD
     # path keeps the jnp formulation — see SwimConfig.use_pallas_fp).
-    use_pallas = jax.default_backend() == "tpu" and not sharded and n % 128 == 0
+    from kaboodle_tpu.ops.fused_fp import pallas_supported
+
+    use_pallas = jax.default_backend() == "tpu" and not sharded and pallas_supported(n)
     cfg = SwimConfig(use_pallas_fp=use_pallas)
     lean = n >= LEAN_STATE_MIN_N
-    st = init_state(n, seed=0, track_latency=not lean, instant_identity=lean)
+    # int16 timers are only valid below ~32k ticks (init_state contract).
+    # Budget for the adaptive timing floor too: it grows the scan x8 at a
+    # time while staying within the ticks*1024 ceiling.
+    max_eff_ticks = ticks
+    while max_eff_ticks * 8 <= ticks * 1024:
+        max_eff_ticks *= 8
+    narrow_ok = max_eff_ticks < jnp.iinfo(jnp.int16).max
+    narrow = lean and narrow_ok
+    st = init_state(n, seed=0, track_latency=not lean, instant_identity=lean,
+                    timer_dtype=jnp.int16 if narrow else jnp.int32)
     rtt = _null_rtt()
 
     if sharded:
@@ -138,7 +149,7 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
         "scan_wall_s": elapsed,
         "peers_ticks_per_sec": n * ticks / elapsed,
         "null_rtt_s": rtt,
-        "state_variant": "lean" if lean else "full",
+        "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
         "pallas_fp": use_pallas,
         "peak_hbm_mib": _peak_device_memory_mib(),
     }
@@ -168,6 +179,8 @@ def _bench_gossip_boot(sizes, max_ticks: int, ring_contacts: int = 2):
     from kaboodle_tpu.sim.runner import run_until_converged
     from kaboodle_tpu.sim.state import init_state
 
+    import jax.numpy as jnp
+
     cfg = SwimConfig(join_broadcast_enabled=False)
     out = []
     for n in sizes:
@@ -175,6 +188,7 @@ def _bench_gossip_boot(sizes, max_ticks: int, ring_contacts: int = 2):
         st = init_state(
             n, seed=0, ring_contacts=ring_contacts,
             track_latency=not lean, instant_identity=lean,
+            timer_dtype=jnp.int16 if lean else jnp.int32,
         )
         t0 = time.perf_counter()
         _, ticks, conv = run_until_converged(st, cfg, max_ticks=max_ticks)

@@ -22,8 +22,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-_I32_MAX = jnp.iinfo(jnp.int32).max
-
 
 def choose_one_of_oldest_k(
     timer: jax.Array,
@@ -50,10 +48,14 @@ def choose_one_of_oldest_k(
     """
     n = timer.shape[-1]
     k = min(k, n)
-    scores = jnp.where(eligible, timer, _I32_MAX)
+    # The "ineligible" sentinel must live in the timer's dtype (int16 in the
+    # lean-memory mode): a bare INT32_MAX would wrap to -1 and make
+    # ineligible entries look like the oldest candidates.
+    tmax = jnp.asarray(jnp.iinfo(timer.dtype).max, dtype=timer.dtype)
+    scores = jnp.where(eligible, timer, tmax)
     # top_k of negated scores = k smallest timers, ascending, stable.
     neg_vals, idx = jax.lax.top_k(-scores, k)  # [N, k]
-    valid = neg_vals != -_I32_MAX
+    valid = neg_vals != -tmax
     count = jnp.sum(valid, axis=-1)  # [N]
     if deterministic:
         choice = jnp.zeros(timer.shape[0], dtype=jnp.int32)

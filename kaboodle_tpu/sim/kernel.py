@@ -40,7 +40,8 @@ Documented deviations beyond the oracle's D1-D3 (see PARITY.md):
   distributional, not samplewise.
 
 Memory/layout notes (TPU):
-- ``state`` int8 and ``timer`` int32 are the only [N, N] residents; every
+- ``state`` int8 and ``timer`` int32 (int16 in lean mode) are the only
+  mandatory [N, N] residents; every
   message "queue" is O(N) or O(N·k) (the per-tick fan-outs are bounded by the
   protocol: 1 ping, k=3 ping-reqs, 1 anti-entropy request per peer).
 - The only O(N^3) work is the join-response gossip union (and, in
@@ -117,6 +118,12 @@ def make_tick_fn(
         key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
 
         S, T = st.state, st.timer
+        # Timer writes must stay in the timer's dtype (int32 default, int16
+        # in the memory-lean mode — see MEMORY_PLAN.md): a bare `t` in a
+        # where() would promote the whole [N, N] tensor to int32 and break
+        # the scan carry. Comparisons (t - T) still compute in int32.
+        tT = t.astype(T.dtype)
+        TMAX = int(jnp.iinfo(T.dtype).max)
         lat, idv = st.latency, st.id_view
         has_lat = lat is not None
         has_idv = idv is not None
@@ -130,7 +137,7 @@ def make_tick_fn(
             alive = (alive & ~inp.kill) | inp.revive
             rv = inp.revive
             S = jnp.where(rv[:, None], jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)), S)
-            T = jnp.where(rv[:, None], jnp.where(eye, t, 0), T)
+            T = jnp.where(rv[:, None], jnp.where(eye, tT, jnp.zeros((), T.dtype)), T)
             if has_lat:
                 lat = jnp.where(rv[:, None], jnp.nan, lat)
             if has_idv:
@@ -193,7 +200,7 @@ def make_tick_fn(
             if has_idv:
                 idv = jnp.where(mark, id_row, idv)
             S = jnp.where(mark, jnp.int8(KNOWN), S)
-            T = jnp.where(mark, t, T)
+            T = jnp.where(mark, tT, T)
             return S, T, lat, idv
 
         # ================= A. Active phase (kaboodle.rs:746-757) ==============
@@ -217,7 +224,7 @@ def make_tick_fn(
         timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (age0 >= cfg.ping_timeout_ticks)
         has_timed = jnp.any(timed_wfp, axis=-1)
         # D1: escalate exactly one — the oldest, ties toward the lower index.
-        tsel = jnp.where(timed_wfp, T0, _I32MAX)
+        tsel = jnp.where(timed_wfp, T0, TMAX)
         min_t = jnp.min(tsel, axis=-1)
         jstar_mask = timed_wfp & (T0 == min_t[:, None])
         jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
@@ -262,7 +269,7 @@ def make_tick_fn(
         # Q3) — modeled only in intended-semantics mode below.
         esc_cell = escalate[:, None] & jstar_cell
         S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
-        T = jnp.where(esc_cell, t, T)
+        T = jnp.where(esc_cell, tT, T)
 
         # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
         elig = alive[:, None] & (S == KNOWN) & ~eye
@@ -270,7 +277,7 @@ def make_tick_fn(
         has_ping = ping_tgt >= 0
         tgt_cell = has_ping[:, None] & (idx[None, :] == ping_tgt[:, None])
         S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
-        T = jnp.where(tgt_cell, t, T)
+        T = jnp.where(tgt_cell, tT, T)
 
         # A4: manual pings (ping_addrs, kaboodle.rs:550-556): no state change at
         # the sender. Self-pings and out-of-range targets are dropped at the
@@ -294,7 +301,7 @@ def make_tick_fn(
             Jm = join_b[None, :] & ok.T & ~eye  # [receiver, origin]
             is_new_ro = Jm & ~member_a
             S = jnp.where(Jm, jnp.int8(KNOWN), S)
-            T = jnp.where(Jm, t, T)
+            T = jnp.where(Jm, tT, T)
             if has_idv:
                 idv = jnp.where(Jm, id_row, idv)
         else:
@@ -407,7 +414,7 @@ def make_tick_fn(
             def _gossip_insert(S, T, idv):
                 gossip_new = gossip & ~(S > 0)
                 S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
-                T = jnp.where(gossip_new, t - cfg.max_peer_share_age_ticks, T)
+                T = jnp.where(gossip_new, tT - cfg.max_peer_share_age_ticks, T)
                 if has_idv:
                     idv = jnp.where(gossip_new, id_row, idv)
                 return S, T, idv
@@ -475,7 +482,7 @@ def make_tick_fn(
                 cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
                 clr_cell = cleared[:, None] & jstar_cell & (S > 0)
                 S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
-                T = jnp.where(clr_cell, t, T)
+                T = jnp.where(clr_cell, tT, T)
             return S, T, lat, idv
 
         S, T, lat, idv = jax.lax.cond(
@@ -566,7 +573,7 @@ def make_tick_fn(
         mark_rep = jnp.zeros((n, n), dtype=bool)
         mark_rep = _scatter_or(mark_rep, idx, partner, del_rep)  # requester marks partner
         S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
-        T = jnp.where(mark_rep, t, T)
+        T = jnp.where(mark_rep, tT, T)
 
         def _kpr_reply_insert(S, T, idv):
             share_f = (S_share == KNOWN) & ~eye & (
@@ -575,7 +582,7 @@ def make_tick_fn(
             srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
             rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
             S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
-            T2 = jnp.where(rep_ins, t - cfg.max_peer_share_age_ticks, T)
+            T2 = jnp.where(rep_ins, tT - cfg.max_peer_share_age_ticks, T)
             if has_idv:
                 # The reply carries (addr, identity) records (structs.rs:110);
                 # identity words resolve to the peers' current identities

@@ -34,7 +34,7 @@ class MeshState:
     """Complete simulator state for N peers. See module docstring."""
 
     state: jax.Array  # int8  [N, N] spec state codes
-    timer: jax.Array  # int32 [N, N] tick stamps
+    timer: jax.Array  # int32 [N, N] tick stamps (int16 in lean mode, MEMORY_PLAN.md)
     alive: jax.Array  # bool  [N]    silent-leave churn (quirk Q8)
     identity: jax.Array  # uint32 [N] identity word per peer (lib.rs:88-92)
     never_broadcast: jax.Array  # bool [N]  true until the first Join broadcast
@@ -105,6 +105,7 @@ def init_state(
     ring_contacts: int = 0,
     track_latency: bool = True,
     instant_identity: bool = False,
+    timer_dtype=jnp.int32,
 ) -> MeshState:
     """Fresh mesh: every peer knows only itself (kaboodle.rs:144-152) and will
     broadcast Join on its first active phase (kaboodle.rs:228-251).
@@ -115,6 +116,12 @@ def init_state(
     spread via traffic + anti-entropy instead of the broadcast domain.
     ``track_latency=False`` / ``instant_identity=True`` drop the optional
     [N, N] tensors (see MeshState) for throughput/memory-bound runs.
+
+    ``timer_dtype=jnp.int16`` halves the timer tensor (the biggest lean-state
+    resident — MEMORY_PLAN.md) and is safe for runs under ~32k ticks: every
+    kernel write stays in the timer's dtype, ages compute in int32, and the
+    only negative stamps (Q6 back-dating) are small. Caller's contract: the
+    tick count must stay below ``iinfo(timer_dtype).max``.
     """
     idx = jnp.arange(n, dtype=jnp.int32)
     eye = idx[:, None] == idx[None, :]
@@ -130,7 +137,7 @@ def init_state(
         member = member | (delta <= ring_contacts)
     return MeshState(
         state=jnp.where(member, jnp.int8(KNOWN), jnp.int8(0)),
-        timer=jnp.zeros((n, n), dtype=jnp.int32),
+        timer=jnp.zeros((n, n), dtype=timer_dtype),
         alive=jnp.ones((n,), dtype=bool) if alive is None else alive,
         identity=identities,
         never_broadcast=jnp.ones((n,), dtype=bool),

@@ -55,9 +55,13 @@ def main() -> None:
     cfg = SwimConfig()
     # MEMORY_PLAN.md policy: large N automatically selects the memory-lean
     # state (no latency EWMA / instant identity) — same rule as bench.py.
+    import jax.numpy as jnp
+
     lean = n >= LEAN_STATE_MIN_N
     st = shard_state(
-        init_state(n, seed=0, track_latency=not lean, instant_identity=lean), mesh
+        init_state(n, seed=0, track_latency=not lean, instant_identity=lean,
+                   timer_dtype=jnp.int16 if lean else jnp.int32),
+        mesh,
     )
 
     # Same every-fault-path schedule the driver dry run validates, at scale.

@@ -53,7 +53,8 @@ def test_lean_state_roundtrip(tmp_path):
     what the 65k-peer configs run — must roundtrip with its optional fields
     restored as None, and resume bit-exactly."""
     n, cfg = 16, SwimConfig()
-    st = init_state(n, seed=5, track_latency=False, instant_identity=True)
+    st = init_state(n, seed=5, track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
     mid, _ = simulate(st, idle_inputs(n, ticks=5), cfg)
     unbroken, _ = simulate(mid, idle_inputs(n, ticks=5), cfg)
 
@@ -61,6 +62,7 @@ def test_lean_state_roundtrip(tmp_path):
     checkpoint.save(path, mid)
     resumed_mid = checkpoint.load(path)
     assert resumed_mid.latency is None and resumed_mid.id_view is None
+    assert resumed_mid.timer.dtype == jnp.int16  # narrow dtype survives
     _states_equal(mid, resumed_mid)
     resumed, _ = simulate(resumed_mid, idle_inputs(n, ticks=5), cfg)
     _states_equal(unbroken, resumed)

@@ -206,6 +206,28 @@ def test_intended_failed_broadcast_parity():
     _run_parity(mesh, st, plan, cfg=cfg)
 
 
+def test_int16_timer_parity():
+    """timer_dtype=int16 (the lean-memory mode, MEMORY_PLAN.md): bit-identical
+    trajectory vs the oracle through churn + revive — exercises every timer
+    write class (marks, waiting stamps, Q6 negative back-dating, revive
+    reset) in the narrow dtype, plus the TMAX sentinel reduction."""
+    mesh = LockstepMesh(N, CFG)
+    st = init_state(N, timer_dtype=jnp.int16)
+    assert st.timer.dtype == jnp.int16
+    plan = []
+    for i in range(20):
+        kill = np.zeros(N, bool)
+        revive = np.zeros(N, bool)
+        if i == 4:
+            kill[2] = True
+            kill[7] = True
+        if i == 14:
+            revive[2] = True
+        plan.append(_inputs(N, kill=kill, revive=revive))
+    final = _run_parity(mesh, st, plan)
+    assert final.timer.dtype == jnp.int16
+
+
 def test_gossip_boot_parity():
     """Gossip boot (join_broadcast_enabled=False + ring seed contacts):
     membership spreads only via pings + anti-entropy pulls

@@ -46,8 +46,15 @@ def _null_rtt() -> float:
 # identity) is selected automatically — see MEMORY_PLAN.md for the budget.
 LEAN_STATE_MIN_N = 4096
 
+# Adaptive timing-floor growth policy (see the loop in _bench): the scan
+# grows by x_FLOOR_GROWTH per step while staying within ticks*_FLOOR_CEILING.
+# The int16-timer eligibility check derives from the same constants.
+_FLOOR_GROWTH = 8
+_FLOOR_CEILING = 1024
 
-def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
+
+def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
+           profile_dir: str | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -63,11 +70,10 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
     cfg = SwimConfig(use_pallas_fp=use_pallas)
     lean = n >= LEAN_STATE_MIN_N
     # int16 timers are only valid below ~32k ticks (init_state contract).
-    # Budget for the adaptive timing floor too: it grows the scan x8 at a
-    # time while staying within the ticks*1024 ceiling.
+    # Budget for the adaptive timing floor too: the largest scan it can grow.
     max_eff_ticks = ticks
-    while max_eff_ticks * 8 <= ticks * 1024:
-        max_eff_ticks *= 8
+    while max_eff_ticks * _FLOOR_GROWTH <= ticks * _FLOOR_CEILING:
+        max_eff_ticks *= _FLOOR_GROWTH
     narrow_ok = max_eff_ticks < jnp.iinfo(jnp.int16).max
     narrow = lean and narrow_ok
     st = init_state(n, seed=0, track_latency=not lean, instant_identity=lean,
@@ -133,14 +139,21 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False):
     # subtraction is all noise (seen at small N on the real chip) — grow the
     # scan until the measurement dominates the round-trip.
     eff_ticks = ticks
-    while elapsed < 5 * rtt and eff_ticks * 8 <= ticks * 1024:
-        eff_ticks *= 8
+    while elapsed < 5 * rtt and eff_ticks * _FLOOR_GROWTH <= ticks * _FLOOR_CEILING:
+        eff_ticks *= _FLOOR_GROWTH
         inp = _place_inputs(idle_inputs(n, ticks=eff_ticks))
         int(run(st, inp))  # compile + warm at the new length
         t0 = time.perf_counter()
         int(run(st, inp))
         elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
     ticks = eff_ticks
+    if profile_dir:
+        # One profiled execution after the timed one, so the capture overhead
+        # cannot pollute the reported numbers (SURVEY §5 tracing slot).
+        from kaboodle_tpu.profiling import trace
+
+        with trace(profile_dir):
+            int(run(st, inp))
     return {
         "converged": bool(conv),
         "ticks_to_convergence": conv_ticks_v,
@@ -185,10 +198,11 @@ def _bench_gossip_boot(sizes, max_ticks: int, ring_contacts: int = 2):
     out = []
     for n in sizes:
         lean = n >= LEAN_STATE_MIN_N
+        narrow = lean and max_ticks < jnp.iinfo(jnp.int16).max
         st = init_state(
             n, seed=0, ring_contacts=ring_contacts,
             track_latency=not lean, instant_identity=lean,
-            timer_dtype=jnp.int16 if lean else jnp.int32,
+            timer_dtype=jnp.int16 if narrow else jnp.int32,
         )
         t0 = time.perf_counter()
         _, ticks, conv = run_until_converged(st, cfg, max_ticks=max_ticks)
@@ -283,6 +297,9 @@ def main() -> None:
     p.add_argument("--platform", choices=["cpu"], default=None,
                    help="pin the JAX platform (skips the probe; 'cpu' avoids "
                         "touching a possibly-wedged accelerator plugin)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture a JAX profiler trace of the throughput scan "
+                        "into DIR (open with TensorBoard / xprof)")
     args = p.parse_args()
 
     if args.platform == "cpu":
@@ -318,7 +335,8 @@ def main() -> None:
     used_n = None
     for n in sizes:
         try:
-            result = _bench(n, args.ticks, sharded=sharded)
+            result = _bench(n, args.ticks, sharded=sharded,
+                            profile_dir=args.profile)
             used_n = n
             break
         except Exception as e:

@@ -37,7 +37,8 @@ def choose_one_of_oldest_k(
     toward the lower index (top_k is stable), matching the oracle's stable sort.
 
     Args:
-      timer: int32 ``[N, N]`` last-heard tick (row i's view of peer j).
+      timer: int32 or int16 ``[N, N]`` last-heard tick (row i's view of
+        peer j; int16 in the lean-memory mode, MEMORY_PLAN.md).
       eligible: bool ``[N, N]`` candidate mask (Known, not self).
       k: NUM_CANDIDATE_TARGET_PEERS.
       key: PRNG key.

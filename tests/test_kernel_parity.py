@@ -362,6 +362,31 @@ def test_id_view_refreshed_on_anti_entropy_insert():
     )
 
 
+def test_intended_indirect_ack_parity():
+    """faithful_indirect_ack=False (SWIM-paper semantics, quirk Q11 off): a
+    forwarded indirect-ping Ack clears the suspect's suspicion instead of
+    only resurrecting the proxy. Churn triggers real escalations so the
+    forwarded-ack clearing path actually runs in both engines."""
+    cfg = SwimConfig(deterministic=True, faithful_indirect_ack=False)
+    mesh = LockstepMesh(N, cfg)
+    st = init_state(N)
+    # Peer 3 is alive but its unicasts to peer 0 are always lost: 0
+    # eventually pings 3, times out, escalates — and the proxies' forwarded
+    # acks (3 is alive and answers them) clear the suspicion, the branch
+    # unique to this mode. A kill exercises true-positive removal alongside.
+    dok = np.ones((N, N), bool)
+    dok[3, 0] = False
+    plan = []
+    for i in range(30):
+        kill = np.zeros(N, bool)
+        if i == 6:
+            kill[8] = True
+        plan.append(_inputs(N, kill=kill, drop_ok=dok))
+    st = _run_parity(mesh, st, plan, cfg=cfg)
+    # The false positive was indeed cleared, not removed: 0 still knows 3.
+    assert np.asarray(st.state)[0, 3] > 0
+
+
 def test_manual_self_ping_dropped():
     """D8: manual self-pings are dropped at the transport in both engines."""
     mesh = LockstepMesh(N, CFG)

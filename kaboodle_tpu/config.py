@@ -61,6 +61,17 @@ class SwimConfig:
     # with ``init_state(ring_contacts=...)`` seed contacts.
     join_broadcast_enabled: bool = True
 
+    # --- protocol extensions (not in the reference; defaults are faithful) --
+    # Q6 back-dating (kaboodle.rs:459-470) inserts gossip-learned peers as if
+    # last heard MAX_PEER_SHARE_AGE ago, so they are never re-shared before
+    # direct contact — the anti-echo that stops departed peers circulating
+    # forever, at the cost of O(N)-tick membership spread (SEMANTICS §6b).
+    # False = "epidemic boot": learned peers get fresh stamps and re-share
+    # immediately, so anti-entropy pulls double knowledge per tick and a
+    # broadcast-free boot converges in ~O(log N) ticks. Use for bootstrap
+    # benchmarks/meshes without churn; the echo protection is off.
+    backdate_gossip_inserts: bool = True
+
     # --- parity flags for behavioral quirks (SURVEY.md §8) ------------------
     # Q1/Q11: an inbound datagram marks its *sender* Known (kaboodle.rs:408-415);
     # a forwarded indirect-ping Ack therefore resurrects the proxy, NOT the

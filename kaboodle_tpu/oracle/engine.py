@@ -390,10 +390,16 @@ class PeerEngine:
 
         elif isinstance(msg, KnownPeersMsg):
             # Q6: insert unknown peers back-dated (kaboodle.rs:448-472).
-            backdated = now - self.cfg.max_peer_share_age_ticks
+            # With backdate_gossip_inserts=False (epidemic boot extension),
+            # learned peers get fresh stamps and re-share immediately.
+            stamp = now - (
+                self.cfg.max_peer_share_age_ticks
+                if self.cfg.backdate_gossip_inserts
+                else 0
+            )
             for addr, identity in msg.peers:
                 if addr not in self.known:
-                    self.known[addr] = PeerRecord(identity, KNOWN, backdated)
+                    self.known[addr] = PeerRecord(identity, KNOWN, stamp)
 
         elif isinstance(msg, KnownPeersRequest):
             share = self._share_snapshot_filtered(sender, now)

@@ -162,6 +162,11 @@ def make_tick_fn(
 
         member0 = S > 0
         row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
+        # Q6 insert stamp offset, shared by the join-gossip and anti-entropy
+        # reply inserts (0 = the epidemic-boot extension, config.py).
+        gossip_backdate = (
+            cfg.max_peer_share_age_ticks if cfg.backdate_gossip_inserts else 0
+        )
         rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
         u_row = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (n, n))
 
@@ -414,7 +419,7 @@ def make_tick_fn(
             def _gossip_insert(S, T, idv):
                 gossip_new = gossip & ~(S > 0)
                 S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
-                T = jnp.where(gossip_new, tT - cfg.max_peer_share_age_ticks, T)
+                T = jnp.where(gossip_new, tT - gossip_backdate, T)
                 if has_idv:
                     idv = jnp.where(gossip_new, id_row, idv)
                 return S, T, idv
@@ -582,7 +587,7 @@ def make_tick_fn(
             srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
             rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
             S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
-            T2 = jnp.where(rep_ins, tT - cfg.max_peer_share_age_ticks, T)
+            T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
             if has_idv:
                 # The reply carries (addr, identity) records (structs.rs:110);
                 # identity words resolve to the peers' current identities

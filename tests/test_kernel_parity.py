@@ -253,6 +253,39 @@ def test_gossip_boot_churn_parity():
     _run_parity(mesh, st, plan, cfg=cfg)
 
 
+def test_epidemic_boot_parity():
+    """backdate_gossip_inserts=False (the epidemic-boot extension): learned
+    peers re-share immediately, so a broadcast-free boot converges in
+    ~O(log N) ticks instead of ~O(N). Exact per-tick parity, and the
+    speedup is visible at N=12 already."""
+    cfg = SwimConfig(
+        deterministic=True,
+        join_broadcast_enabled=False,
+        backdate_gossip_inserts=False,
+    )
+    mesh = LockstepMesh(N, cfg, ring_contacts=2)
+    st = init_state(N, ring_contacts=2)
+    _run_parity(mesh, st, [_inputs(N) for _ in range(10)], cfg=cfg)
+    assert mesh.converged(), "epidemic boot should converge within 10 ticks at N=12"
+
+
+def test_epidemic_boot_scales_logarithmically():
+    """Convergence ticks for the epidemic boot grow far slower than N —
+    the whole point of the extension (random mode, ring seed)."""
+    from kaboodle_tpu.sim.runner import run_until_converged
+
+    cfg = SwimConfig(join_broadcast_enabled=False, backdate_gossip_inserts=False)
+    ticks_at = {}
+    for n in (64, 256):
+        st = init_state(n, seed=0, ring_contacts=2)
+        _, ticks, conv = run_until_converged(st, cfg, max_ticks=128)
+        assert bool(conv), f"N={n} did not converge"
+        ticks_at[n] = int(ticks)
+    # 4x the peers must cost far less than 4x the ticks (O(N) would be ~4x;
+    # allow generous slack above log2(4)=2x for protocol noise).
+    assert ticks_at[256] < ticks_at[64] * 3, ticks_at
+
+
 def test_share_cap_parity():
     """D5: the join-response share cap (kernel.py share_base branch; the
     reference's 10 KiB trim, kaboodle.rs:373-383). An isolated peer joins

@@ -1,0 +1,44 @@
+"""Observability hooks: structured per-tick tables, log lines, trace capture."""
+
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.profiling import log_run, tick_stats, trace
+from kaboodle_tpu.sim import idle_inputs, init_state, simulate
+
+
+def _run(n=16, ticks=6):
+    cfg = SwimConfig()
+    return simulate(init_state(n, seed=1), idle_inputs(n, ticks=ticks), cfg)
+
+
+def test_tick_stats_table_matches_metrics():
+    _, m = _run()
+    table = tick_stats(m)
+    assert table.shape == (6,)
+    np.testing.assert_array_equal(table["tick"], np.arange(6))
+    np.testing.assert_array_equal(
+        table["messages_delivered"], np.asarray(m.messages_delivered)
+    )
+    np.testing.assert_array_equal(table["converged"], np.asarray(m.converged))
+    # Boot converges at tick 0 (join broadcast) and membership is full.
+    assert table["converged"][-1]
+    assert table["mean_membership"][-1] == 16.0
+    assert (table["fingerprint_min"] == table["fingerprint_max"])[-1]
+
+
+def test_log_run_emits_one_line_per_tick():
+    _, m = _run()
+    lines = []
+    log_run(m, emit=lines.append)
+    assert len(lines) == 6
+    assert all(line.startswith("tick ") for line in lines)
+    assert "CONVERGED" in lines[-1]
+
+
+def test_trace_captures_profile(tmp_path):
+    with trace(str(tmp_path)):
+        _run(n=8, ticks=2)
+    # The JAX profiler writes its plugin tree under the log dir.
+    captured = list(tmp_path.rglob("*"))
+    assert captured, "profiler trace produced no files"

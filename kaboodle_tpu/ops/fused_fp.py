@@ -43,20 +43,33 @@ from kaboodle_tpu.ops.hashing import peer_record_hash
 _VMEM_BLOCK_BYTES = 2 * 1024 * 1024
 
 
+def _masked_wrap_sum(member, h):
+    # Mosaic has no unsigned reductions; sum in int32 via bitcast — two's
+    # complement wraparound addition is bit-identical to uint32 modular
+    # addition, so the result is still exact.
+    masked = jax.lax.bitcast_convert_type(
+        jnp.where(member, h, jnp.uint32(0)), jnp.int32
+    )
+    return jax.lax.bitcast_convert_type(
+        jnp.sum(masked, axis=1, keepdims=True), jnp.uint32
+    )
+
+
 def _kernel_idv(state_ref, idv_ref, fp_ref, cnt_ref):
-    member = state_ref[:] > 0
+    # Upcast in VMEM: Mosaic on v5e lacks sub-32-bit vector compares.
+    member = state_ref[:].astype(jnp.int32) > 0
     # The canonical record hash (ops.hashing) is plain jnp, so it runs inside
     # the kernel body unchanged — one definition for both formulations.
     pid = jax.lax.broadcasted_iota(jnp.uint32, idv_ref.shape, 1)
     h = peer_record_hash(pid, idv_ref[:])
-    fp_ref[:] = jnp.sum(jnp.where(member, h, jnp.uint32(0)), axis=1, keepdims=True)
+    fp_ref[:] = _masked_wrap_sum(member, h)
     cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
 
 
 def _kernel_hash(state_ref, hash_ref, fp_ref, cnt_ref):
-    member = state_ref[:] > 0
+    member = state_ref[:].astype(jnp.int32) > 0
     h = jnp.broadcast_to(hash_ref[:], member.shape)
-    fp_ref[:] = jnp.sum(jnp.where(member, h, jnp.uint32(0)), axis=1, keepdims=True)
+    fp_ref[:] = _masked_wrap_sum(member, h)
     cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
 
 

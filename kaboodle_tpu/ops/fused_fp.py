@@ -51,7 +51,7 @@ def _masked_wrap_sum(member, h):
         jnp.where(member, h, jnp.uint32(0)), jnp.int32
     )
     return jax.lax.bitcast_convert_type(
-        jnp.sum(masked, axis=1, keepdims=True), jnp.uint32
+        jnp.sum(masked, axis=1, keepdims=True, dtype=jnp.int32), jnp.uint32
     )
 
 

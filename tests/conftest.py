@@ -9,6 +9,17 @@ this is still before any device materializes).
 """
 
 import os
+import sys
+
+# The axon TPU plugin (injected via PYTHONPATH=/root/.axon_site) contacts the
+# device tunnel at import time; while the tunnel is wedged that import hangs
+# forever — which would hang `import jax` below even with JAX_PLATFORMS=cpu.
+# Tests never touch the real chip, so drop the plugin from the search path
+# before jax's plugin discovery can see it (must happen before `import jax`).
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if ".axon_site" not in p
+)
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -98,8 +98,17 @@ class SwimConfig:
     # requires N % 128 == 0. Off TPU it runs in pallas interpreter mode
     # (correct but slow) — bench.py enables it on the single-chip TPU path.
     use_pallas_fp: bool = False
+    # How the ping-target draw finds each row's oldest-k Known peers
+    # (kaboodle.rs:661-675): "topk" = jax.lax.top_k (sort-based on TPU),
+    # "iter" = k rounds of lexicographic min-reduction over (timer, index) —
+    # identical results (both are the stable k-smallest; equality pinned in
+    # tests/test_sampling.py), but the iterative form avoids sorting an
+    # [N, N] matrix per tick, which dominates the tick on TPU at large N.
+    oldest_k_method: str = "iter"
 
     def __post_init__(self) -> None:
+        if self.oldest_k_method not in ("topk", "iter"):
+            raise ValueError("oldest_k_method must be 'topk' or 'iter'")
         if self.ping_timeout_ticks < 1:
             raise ValueError("ping_timeout_ticks must be >= 1")
         if self.num_indirect_ping_peers < 1:

@@ -23,12 +23,59 @@ import jax
 import jax.numpy as jnp
 
 
+def _stable_k_smallest_topk(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:
+    """(idx, valid) of the k smallest scores per row via sort-based top_k.
+
+    Negation overflows at the dtype minimum (-(-32768) == -32768 in int16),
+    which would sort dtype-min scores as the *largest*; timers can go
+    slightly negative (Q6 back-dating near tick 0), so widen int16 before
+    negating. int32 scores keep the documented timer precondition
+    (> INT32_MIN, trivially true for tick stamps)."""
+    wide = scores.astype(jnp.int32) if scores.dtype == jnp.int16 else scores
+    neg_vals, idx = jax.lax.top_k(-wide, k)  # [N, k]
+    return idx.astype(jnp.int32), neg_vals != -wide.dtype.type(tmax)
+
+
+def _stable_k_smallest_iter(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:
+    """(idx, valid) of the k smallest scores per row, ties toward lower index.
+
+    k rounds of lexicographic min-reduction over (score, index): round r
+    restricts to entries strictly greater (lex) than round r-1's pick and
+    takes the min. Identical to stable top_k (equality pinned in
+    tests/test_sampling.py), but each round is a fused masked reduction —
+    no [N, N] sort, which is what top_k lowers to on TPU and what dominated
+    the tick at large N.
+    """
+    n = scores.shape[-1]
+    idxr = jnp.arange(n, dtype=jnp.int32)[None, :]
+    big_i = jnp.int32(n)  # index sentinel > any real column
+    prev_s = jnp.full(scores.shape[:-1], jnp.iinfo(scores.dtype).min, scores.dtype)
+    prev_i = jnp.full(scores.shape[:-1], -1, jnp.int32)
+    out_i, out_v = [], []
+    for _ in range(k):
+        after_prev = (scores > prev_s[:, None]) | (
+            (scores == prev_s[:, None]) & (idxr > prev_i[:, None])
+        )
+        s_r = jnp.min(jnp.where(after_prev, scores, tmax), axis=-1)
+        i_r = jnp.min(
+            jnp.where(after_prev & (scores == s_r[:, None]), idxr, big_i), axis=-1
+        )
+        # A row is exhausted when only sentinel scores remain; sentinel cells
+        # themselves are still ordered by index, matching top_k's tail.
+        out_i.append(i_r)
+        out_v.append(s_r != tmax)
+        prev_s, prev_i = s_r, i_r
+    idx = jnp.minimum(jnp.stack(out_i, axis=-1), n - 1)  # big_i only where invalid
+    return idx, jnp.stack(out_v, axis=-1)
+
+
 def choose_one_of_oldest_k(
     timer: jax.Array,
     eligible: jax.Array,
     k: int,
     key: jax.Array,
     deterministic: bool = False,
+    method: str = "iter",
 ) -> jax.Array:
     """Per row: uniform choice among the k eligible entries with smallest timer.
 
@@ -43,6 +90,8 @@ def choose_one_of_oldest_k(
       k: NUM_CANDIDATE_TARGET_PEERS.
       key: PRNG key.
       deterministic: pick the single oldest instead of randomizing.
+      method: "topk" (sort-based) or "iter" (k fused min-reductions) — same
+        results, different TPU cost profile (see SwimConfig.oldest_k_method).
 
     Returns int32 ``[N]``: chosen column per row, or -1 if the row has no
     eligible entries.
@@ -54,9 +103,12 @@ def choose_one_of_oldest_k(
     # ineligible entries look like the oldest candidates.
     tmax = jnp.asarray(jnp.iinfo(timer.dtype).max, dtype=timer.dtype)
     scores = jnp.where(eligible, timer, tmax)
-    # top_k of negated scores = k smallest timers, ascending, stable.
-    neg_vals, idx = jax.lax.top_k(-scores, k)  # [N, k]
-    valid = neg_vals != -tmax
+    if deterministic and method == "iter":
+        # Only the single oldest is consumed — one lex-min round suffices.
+        idx, valid = _stable_k_smallest_iter(scores, 1, tmax)
+        return jnp.where(valid[:, 0], idx[:, 0], -1).astype(jnp.int32)
+    pick = _stable_k_smallest_iter if method == "iter" else _stable_k_smallest_topk
+    idx, valid = pick(scores, k, tmax)
     count = jnp.sum(valid, axis=-1)  # [N]
     if deterministic:
         choice = jnp.zeros(timer.shape[0], dtype=jnp.int32)

@@ -88,8 +88,24 @@ def _bool_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def _scatter_or(dst: jax.Array, rows: jax.Array, cols: jax.Array, val: jax.Array) -> jax.Array:
-    """dst[rows, cols] |= val with -1-safe indices (val must be False there)."""
+    """dst[rows, cols] |= val with -1-safe indices (val must be False there).
+
+    XLA lowers a dynamic-index scatter to a sequential per-update loop on
+    TPU, so this is reserved for escalation-gated paths (which are compiled
+    out of steady-state ticks); the per-tick hot marks use the dense one-hot
+    forms below, which fuse into their consuming ``where`` passes."""
     return dst.at[jnp.clip(rows, 0), jnp.clip(cols, 0)].max(val)
+
+
+def _col_mark(idx: jax.Array, tgt: jax.Array, val: jax.Array) -> jax.Array:
+    """mark[d, s] = (tgt[s] == d) & val[s] — sender s's datagram lands at its
+    target. tgt == -1 never matches (idx >= 0), so no clipping is needed."""
+    return (idx[:, None] == tgt[None, :]) & val[None, :]
+
+
+def _row_mark(idx: jax.Array, tgt: jax.Array, val: jax.Array) -> jax.Array:
+    """mark[s, d] = (tgt[s] == d) & val[s] — row s marks its own target."""
+    return (idx[None, :] == tgt[:, None]) & val[:, None]
 
 
 def _gather_edge(mat: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
@@ -278,9 +294,12 @@ def make_tick_fn(
 
         # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
         elig = alive[:, None] & (S == KNOWN) & ~eye
-        ping_tgt = choose_one_of_oldest_k(T, elig, cfg.num_candidate_target_peers, key_ping, det)
+        ping_tgt = choose_one_of_oldest_k(
+            T, elig, cfg.num_candidate_target_peers, key_ping, det,
+            method=cfg.oldest_k_method,
+        )
         has_ping = ping_tgt >= 0
-        tgt_cell = has_ping[:, None] & (idx[None, :] == ping_tgt[:, None])
+        tgt_cell = _row_mark(idx, ping_tgt, has_ping)
         S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
         T = jnp.where(tgt_cell, tT, T)
 
@@ -385,10 +404,12 @@ def make_tick_fn(
         ok_man = (man_tgt >= 0) & _gather_edge(ok, idx, man_tgt)
         del_pr = proxies_valid & _gather_edge(ok, idx[:, None], proxies)  # [N, k]
 
-        mark1 = jnp.zeros((n, n), dtype=bool)  # mark1[dest, sender]
-        mark1 = _scatter_or(mark1, ping_tgt, idx, ok_ping)
-        mark1 = _scatter_or(mark1, man_tgt, idx, ok_man)
-        mark1 = _scatter_or(mark1, proxies, idx[:, None], del_pr)
+        # mark1[dest, sender]: dense one-hot compares (no scatter) — each term
+        # fuses into apply_marks' where pass. The proxy terms are all-False on
+        # escalation-free ticks but cost only fused compares, not a gather.
+        mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
+        for kk in range(proxies.shape[-1]):
+            mark1 |= _col_mark(idx, proxies[:, kk], del_pr[:, kk])
         S, T, lat, idv = apply_marks(S, T, lat, idv, mark1)
 
         fp1, n1 = fp_count(S, idv)
@@ -401,13 +422,22 @@ def make_tick_fn(
         del_pping = del_pr & ok_p2x  # [N, k]
 
         # ================= Call 2: Acks, proxy Pings, join responses ==========
-        mark2 = jnp.zeros((n, n), dtype=bool)
-        mark2 = _scatter_or(mark2, idx, ping_tgt, del_ack)  # pinger marks target
-        mark2 = _scatter_or(mark2, idx, man_tgt, del_ack_man)
-        mark2 = _scatter_or(
-            mark2, jnp.broadcast_to(jstar[:, None], proxies.shape), proxies, del_pping
-        )  # suspect marks proxy
+        mark2 = _row_mark(idx, ping_tgt, del_ack)  # pinger marks target
+        mark2 |= _row_mark(idx, man_tgt, del_ack_man)
         mark2 |= reply_del.T  # joiner marks join-responder
+        # Suspect-marks-proxy scatters on BOTH dims (jstar rows x proxy cols),
+        # so it has no one-hot form; it is escalation-only, so gate the
+        # scatter out of steady-state ticks.
+        mark2 |= jax.lax.cond(
+            jnp.any(escalate),
+            lambda: _scatter_or(
+                jnp.zeros((n, n), dtype=bool),
+                jnp.broadcast_to(jstar[:, None], proxies.shape),
+                proxies,
+                del_pping,
+            ),
+            lambda: jnp.zeros((n, n), dtype=bool),
+        )
         S, T, lat, idv = apply_marks(S, T, lat, idv, mark2)
 
         # Gossip-learned peers insert back-dated (Q6) where still unknown, with
@@ -560,8 +590,7 @@ def make_tick_fn(
 
         # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
         del_kpr = has_req & _gather_edge(ok, idx, partner)
-        mark_g = jnp.zeros((n, n), dtype=bool)
-        mark_g = _scatter_or(mark_g, partner, idx, del_kpr)  # partner marks requester
+        mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
         S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
 
         # Filtered reply share (kaboodle.rs:483-501): Known peers heard from
@@ -575,8 +604,7 @@ def make_tick_fn(
         # below (the oracle's two-pass order): a partner's own fresh call-G
         # marks must not leak into the rows it shares this tick.
         S_share, T_share = S, T
-        mark_rep = jnp.zeros((n, n), dtype=bool)
-        mark_rep = _scatter_or(mark_rep, idx, partner, del_rep)  # requester marks partner
+        mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
         S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
         T = jnp.where(mark_rep, tT, T)
 

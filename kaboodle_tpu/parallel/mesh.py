@@ -72,6 +72,23 @@ def make_multihost_mesh(
     return the same mesh as :func:`make_mesh`.
     """
     if coordinator_address or (num_processes or 0) > 1:
+        import os
+
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        on_tpu_pod = "tpu" in platforms or "TPU_WORKER_HOSTNAMES" in os.environ
+        if not on_tpu_pod:
+            # CPU clusters (the multi-host test rig, tests/test_multihost.py,
+            # or any CPU-only multi-machine run) need an explicit
+            # cross-process collectives implementation — without one the
+            # first collective hangs. TPU pods bring their own (ICI/DCN) and
+            # must not see this; nothing backend-touching may run before
+            # initialize(), so detection is env-only: enable gloo unless a
+            # TPU platform/pod marker is present (jax defaults to CPU when
+            # JAX_PLATFORMS is unset and no accelerator is found).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except AttributeError:  # renamed/absent in other jax versions
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -1,0 +1,64 @@
+"""One process of the multi-host proof rig (tests/test_multihost.py).
+
+Each process owns 4 virtual CPU devices; ``make_multihost_mesh`` joins them
+into one global 8-device peer-axis mesh (collectives over gloo — the DCN
+stand-in), and the full sharded tick runs over it. Prints a trajectory
+digest the test compares across processes and against the single-process
+run: identical programs over ICI-only and cross-process meshes must produce
+identical protocol trajectories (SURVEY.md §2.3 distributed-backend slot).
+
+Usage: multihost_worker.py <process_id> <num_processes> <port> <n> <ticks>
+"""
+
+import json
+import os
+import sys
+
+# Env must be pinned before anything imports jax.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kaboodle_tpu.config import SwimConfig  # noqa: E402
+from kaboodle_tpu.parallel import (  # noqa: E402
+    make_multihost_mesh,
+    shard_inputs,
+    shard_state,
+    simulate_sharded,
+)
+from kaboodle_tpu.sim.state import idle_inputs, init_state  # noqa: E402
+
+
+def main() -> None:
+    pid, nproc, port, n, ticks = (int(a) for a in sys.argv[1:6])
+    mesh = make_multihost_mesh(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+    # Host-local -> global placement: identical values exist in every process,
+    # so device_put just carves out each process's addressable shards. The
+    # memory-lean state (the realistic multi-host config, MEMORY_PLAN.md)
+    # also avoids the NaN-filled latency tensor, which jax's cross-process
+    # device_put equality check would reject (NaN != NaN elementwise).
+    st = init_state(n, seed=3, track_latency=False, instant_identity=True)
+    st = shard_state(jax.tree.map(np.asarray, st), mesh)
+    inp = shard_inputs(idle_inputs(n, ticks=ticks), mesh, stacked=True)
+    cfg = SwimConfig(deterministic=True)
+    out, m = simulate_sharded(st, inp, cfg, mesh, faulty=False)
+
+    # Metrics are full reductions -> replicated, addressable everywhere.
+    digest = {
+        "process": pid,
+        "n_global_devices": mesh.size,
+        "messages": np.asarray(m.messages_delivered).tolist(),
+        "fp_min": np.asarray(m.fingerprint_min).tolist(),
+        "fp_max": np.asarray(m.fingerprint_max).tolist(),
+        "converged": np.asarray(m.converged).tolist(),
+        "final_tick": int(out.tick),
+    }
+    print("MHDIGEST " + json.dumps(digest), flush=True)
+
+
+if __name__ == "__main__":
+    main()

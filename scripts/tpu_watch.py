@@ -1,0 +1,163 @@
+"""Poll the tunneled TPU until a live window opens, then run the on-device
+perf suite once: tick-component microbench, kernel A/B (scatter/topk vs
+one-hot/iter formulations), and the full bench. Results append to
+TPU_WATCH.log as JSON lines.
+
+The axon tunnel wedges intermittently for hours (TPU_BENCH_NOTES.md); every
+probe and measurement runs in a subprocess under a hard timeout so a wedge
+mid-measurement cannot hang the watcher itself.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+LOG = "TPU_WATCH.log"
+PROBE_TIMEOUT_S = 150
+MEASURE_TIMEOUT_S = 2400
+POLL_INTERVAL_S = 240
+
+MEASURE = r"""
+import json, time, functools
+import numpy as np, jax, jax.numpy as jnp
+
+out = {"ts": time.time(), "kind": "measure"}
+
+def fetch_timeit(f, *a, reps=3):
+    # axon block_until_ready does not synchronize; time via scalar fetch
+    # (see .claude/skills/verify/SKILL.md) and report per-rep seconds.
+    r = f(*a); jax.block_until_ready(r)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*a)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / reps
+
+n = 16384
+rng = np.random.default_rng(0)
+S = jnp.asarray(rng.integers(0, 3, (n, n)), jnp.int8)
+T = jnp.asarray(rng.integers(0, 100, (n, n)), jnp.int16)
+rh = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+elig = S == 1
+key = jax.random.PRNGKey(0)
+
+from kaboodle_tpu.ops.fused_fp import fused_fp_count
+from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
+out["fused_fp_ms"] = fetch_timeit(functools.partial(fused_fp_count, S, rh)) * 1e3
+
+@jax.jit
+def jnp_fp(S, rh):
+    m = S > 0
+    return jnp.sum(jnp.where(m, rh[None, :], jnp.uint32(0)), axis=-1, dtype=jnp.uint32)
+out["jnp_fp_ms"] = fetch_timeit(jnp_fp, S, rh) * 1e3
+
+for method in ("topk", "iter"):
+    f = jax.jit(functools.partial(
+        choose_one_of_oldest_k, k=5, deterministic=False, method=method))
+    out[f"oldest5_{method}_ms"] = fetch_timeit(
+        lambda: f(timer=T, eligible=elig, key=key)) * 1e3
+
+@jax.jit
+def scatter_mark(tgt, val):
+    m = jnp.zeros((n, n), dtype=bool).at[jnp.clip(tgt, 0), jnp.arange(n)].max(val)
+    return jnp.where(m, jnp.int8(1), S).sum(dtype=jnp.int32)
+
+@jax.jit
+def onehot_mark(tgt, val):
+    idx = jnp.arange(n, dtype=jnp.int32)
+    m = (idx[:, None] == tgt[None, :]) & val[None, :]
+    return jnp.where(m, jnp.int8(1), S).sum(dtype=jnp.int32)
+
+tgt = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
+val = jnp.ones((n,), bool)
+out["scatter_mark_ms"] = fetch_timeit(scatter_mark, tgt, val) * 1e3
+out["onehot_mark_ms"] = fetch_timeit(onehot_mark, tgt, val) * 1e3
+
+# Whole-tick A/B at N=16384, lean+int16, fault-free (the bench configuration).
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.runner import simulate
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+st = init_state(n, seed=0, track_latency=False, instant_identity=True,
+                timer_dtype=jnp.int16)
+inp = idle_inputs(n, ticks=8)
+for method in ("topk", "iter"):
+    cfg = SwimConfig(use_pallas_fp=True, oldest_k_method=method)
+    @jax.jit
+    def run(s, i, cfg=cfg):
+        o, _ = simulate(s, i, cfg, faulty=False)
+        return o.timer.sum() + o.tick
+    sec = fetch_timeit(run, st, inp, reps=2)
+    out[f"tick_{method}_ms"] = sec / 8 * 1e3
+
+print("WATCHJSON " + json.dumps(out))
+"""
+
+
+def probe() -> bool:
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        timeout=PROBE_TIMEOUT_S + 10,
+        capture_output=True,
+    )
+    return r.returncode == 0
+
+
+def log(obj) -> None:
+    with open(LOG, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+
+
+def main() -> None:
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            alive = probe()
+        except subprocess.TimeoutExpired:
+            alive = False
+        log({"ts": time.time(), "kind": "probe", "attempt": attempt, "alive": alive})
+        if alive:
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", MEASURE],
+                    timeout=MEASURE_TIMEOUT_S,
+                    capture_output=True,
+                    text=True,
+                )
+                for line in r.stdout.splitlines():
+                    if line.startswith("WATCHJSON "):
+                        log(json.loads(line[len("WATCHJSON "):]))
+                        break
+                else:
+                    log({"ts": time.time(), "kind": "measure_failed",
+                         "rc": r.returncode, "tail": (r.stderr or "")[-2000:]})
+                    time.sleep(POLL_INTERVAL_S)
+                    continue
+            except subprocess.TimeoutExpired:
+                log({"ts": time.time(), "kind": "measure_timeout"})
+                time.sleep(POLL_INTERVAL_S)
+                continue
+            # Microbench landed; now the full bench in the same window.
+            try:
+                r = subprocess.run(
+                    [sys.executable, "bench.py"],
+                    timeout=MEASURE_TIMEOUT_S, capture_output=True, text=True,
+                )
+                tail = [ln for ln in r.stdout.splitlines() if ln.strip()]
+                log({"ts": time.time(), "kind": "bench", "rc": r.returncode,
+                     "json": tail[-1] if tail else None})
+            except subprocess.TimeoutExpired:
+                log({"ts": time.time(), "kind": "bench_timeout"})
+            return  # one full capture is the goal; rerun manually for more
+        time.sleep(POLL_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()

@@ -324,7 +324,11 @@ def main() -> None:
     backend = jax.default_backend()
     n_chips = jax.device_count()
     on_tpu = backend not in ("cpu",)
-    sizes = [args.n] if args.n else ([16384, 8192, 4096] if on_tpu else [512])
+    # Single-chip ceiling: N=32,768 lean+int16 is 1 GiB state + 2 GiB timers
+    # persistent, well inside 16 GiB HBM with the scan transients
+    # (MEMORY_PLAN.md); the OOM handler below steps down if a backend proves
+    # otherwise. N=65,536 persistent alone is 12 GiB — sharded-only.
+    sizes = [args.n] if args.n else ([32768, 16384, 8192] if on_tpu else [512])
 
     # Engage every chip when there are several (the sharded GSPMD path);
     # single-chip runs use the plain kernel.
@@ -367,10 +371,11 @@ def main() -> None:
         if gsizes is None:
             gsizes = [256, 512, 1024] if on_tpu else [64, 128]
         gossip = _bench_gossip_boot(gsizes, max_ticks=4096)
-        # Auto-picked TPU sizes stretch the epidemic sweep 4x (it converges in
-        # O(log N)); explicit --gossip-sizes are honored as-is so both modes
-        # report the same N values and are directly comparable.
-        esizes = [n * 4 for n in gsizes] if (on_tpu and args.gossip_sizes is None) else gsizes
+        # Auto-picked TPU sizes stretch the epidemic sweep 16x (it converges
+        # in O(log N), so N up to 16,384 stays cheap); explicit
+        # --gossip-sizes are honored as-is so both modes report the same N
+        # values and are directly comparable.
+        esizes = [n * 16 for n in gsizes] if (on_tpu and args.gossip_sizes is None) else gsizes
         epidemic = _bench_gossip_boot(esizes, max_ticks=512, backdate=False)
 
     value = result["peers_ticks_per_sec"] / n_chips

@@ -74,7 +74,7 @@ def make_multihost_mesh(
     if coordinator_address or (num_processes or 0) > 1:
         import os
 
-        platforms = os.environ.get("JAX_PLATFORMS", "")
+        platforms = os.environ.get("JAX_PLATFORMS", "").lower()
         on_tpu_pod = "tpu" in platforms or "TPU_WORKER_HOSTNAMES" in os.environ
         if not on_tpu_pod:
             # CPU clusters (the multi-host test rig, tests/test_multihost.py,

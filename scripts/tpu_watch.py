@@ -100,13 +100,46 @@ print("WATCHJSON " + json.dumps(out))
 """
 
 
-def probe() -> bool:
-    r = subprocess.run(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        timeout=PROBE_TIMEOUT_S + 10,
-        capture_output=True,
+def _run_group(cmd: list[str], timeout_s: int, discard_output: bool = False):
+    """Run cmd in its own process group with a hard timeout.
+
+    A wedged tunnel helper can inherit our pipes and keep them open past the
+    direct child's death, hanging subprocess.run's drain (the failure mode
+    bench.py's _probe_once documents); kill the whole group on timeout so
+    the pipes actually close. Returns (rc, stdout) — rc None on timeout.
+    """
+    import os
+    import signal
+
+    if discard_output:
+        stdout, stderr = subprocess.DEVNULL, subprocess.DEVNULL
+    else:
+        stdout, stderr = subprocess.PIPE, subprocess.STDOUT
+    proc = subprocess.Popen(
+        cmd, stdout=stdout, stderr=stderr, text=True, start_new_session=True
     )
-    return r.returncode == 0
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out or ""
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, ""
+
+
+def probe() -> bool:
+    rc, _ = _run_group(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        PROBE_TIMEOUT_S,
+        discard_output=True,
+    )
+    return rc == 0
 
 
 def log(obj) -> None:
@@ -118,43 +151,24 @@ def main() -> None:
     attempt = 0
     while True:
         attempt += 1
-        try:
-            alive = probe()
-        except subprocess.TimeoutExpired:
-            alive = False
+        alive = probe()
         log({"ts": time.time(), "kind": "probe", "attempt": attempt, "alive": alive})
         if alive:
-            try:
-                r = subprocess.run(
-                    [sys.executable, "-c", MEASURE],
-                    timeout=MEASURE_TIMEOUT_S,
-                    capture_output=True,
-                    text=True,
-                )
-                for line in r.stdout.splitlines():
-                    if line.startswith("WATCHJSON "):
-                        log(json.loads(line[len("WATCHJSON "):]))
-                        break
-                else:
-                    log({"ts": time.time(), "kind": "measure_failed",
-                         "rc": r.returncode, "tail": (r.stderr or "")[-2000:]})
-                    time.sleep(POLL_INTERVAL_S)
-                    continue
-            except subprocess.TimeoutExpired:
-                log({"ts": time.time(), "kind": "measure_timeout"})
+            rc, out = _run_group([sys.executable, "-c", MEASURE], MEASURE_TIMEOUT_S)
+            for line in out.splitlines():
+                if line.startswith("WATCHJSON "):
+                    log(json.loads(line[len("WATCHJSON "):]))
+                    break
+            else:
+                log({"ts": time.time(), "kind": "measure_failed", "rc": rc,
+                     "tail": out[-2000:]})
                 time.sleep(POLL_INTERVAL_S)
                 continue
             # Microbench landed; now the full bench in the same window.
-            try:
-                r = subprocess.run(
-                    [sys.executable, "bench.py"],
-                    timeout=MEASURE_TIMEOUT_S, capture_output=True, text=True,
-                )
-                tail = [ln for ln in r.stdout.splitlines() if ln.strip()]
-                log({"ts": time.time(), "kind": "bench", "rc": r.returncode,
-                     "json": tail[-1] if tail else None})
-            except subprocess.TimeoutExpired:
-                log({"ts": time.time(), "kind": "bench_timeout"})
+            rc, out = _run_group([sys.executable, "bench.py"], MEASURE_TIMEOUT_S)
+            tail = [ln for ln in out.splitlines() if ln.strip()]
+            log({"ts": time.time(), "kind": "bench", "rc": rc,
+                 "json": tail[-1] if tail else None})
             return  # one full capture is the goal; rerun manually for more
         time.sleep(POLL_INTERVAL_S)
 

@@ -46,12 +46,20 @@ def test_two_process_mesh_matches_single_process():
         for pid in range(2)
     ]
     digests = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        lines = [ln for ln in out.splitlines() if ln.startswith("MHDIGEST ")]
-        assert lines, f"no digest in worker output:\n{out[-1000:]}\n{err[-1000:]}"
-        digests.append(json.loads(lines[0][len("MHDIGEST "):]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            lines = [ln for ln in out.splitlines() if ln.startswith("MHDIGEST ")]
+            assert lines, f"no digest in worker output:\n{out[-1000:]}\n{err[-1000:]}"
+            digests.append(json.loads(lines[0][len("MHDIGEST "):]))
+    finally:
+        # A worker that failed (or we timed out on) leaves its peer blocked
+        # inside a gloo collective waiting forever — reap both regardless.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
 
     a, b = digests
     assert a["n_global_devices"] == b["n_global_devices"] == 8

@@ -173,8 +173,15 @@ def make_tick_fn(
             if inp.drop_ok is not None:
                 ok &= inp.drop_ok
             else:
-                keep = jax.random.uniform(key_drop, (n, n)) >= inp.drop_rate
-                ok &= keep
+                # The [N, N] uniform draw is the single most expensive op of a
+                # drop-free faulty tick — gate it on the (traced) rate so
+                # churn/partition-only scenarios skip the RNG entirely.
+                ok = jax.lax.cond(
+                    inp.drop_rate > 0,
+                    lambda ok: ok & (jax.random.uniform(key_drop, (n, n)) >= inp.drop_rate),
+                    lambda ok: ok,
+                    ok,
+                )
 
         member0 = S > 0
         row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)

@@ -14,6 +14,11 @@ import json
 import subprocess
 import sys
 import time
+from pathlib import Path
+
+# Run as `python scripts/tpu_watch.py`: sys.path[0] is scripts/, so the repo
+# root (for `from bench import _probe_once`) must be added explicitly.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 LOG = "TPU_WATCH.log"
 PROBE_TIMEOUT_S = 150
@@ -134,12 +139,11 @@ def _run_group(cmd: list[str], timeout_s: int, discard_output: bool = False):
 
 
 def probe() -> bool:
-    rc, _ = _run_group(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        PROBE_TIMEOUT_S,
-        discard_output=True,
-    )
-    return rc == 0
+    # The probe-under-wedge pattern lives in bench.py (_probe_once: DEVNULL
+    # pipes, own session, group kill); reuse it so the two stay in sync.
+    from bench import _probe_once
+
+    return _probe_once(PROBE_TIMEOUT_S)
 
 
 def log(obj) -> None:
@@ -165,10 +169,22 @@ def main() -> None:
                 time.sleep(POLL_INTERVAL_S)
                 continue
             # Microbench landed; now the full bench in the same window.
+            # stderr is merged into the capture, so find the result by
+            # parsing rather than position: the last line that is JSON with
+            # the bench's "metric" key.
             rc, out = _run_group([sys.executable, "bench.py"], MEASURE_TIMEOUT_S)
-            tail = [ln for ln in out.splitlines() if ln.strip()]
-            log({"ts": time.time(), "kind": "bench", "rc": rc,
-                 "json": tail[-1] if tail else None})
+            result = None
+            for ln in reversed(out.splitlines()):
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        if "metric" in json.loads(ln):
+                            result = ln
+                            break
+                    except json.JSONDecodeError:
+                        continue
+            log({"ts": time.time(), "kind": "bench", "rc": rc, "json": result,
+                 **({} if result else {"tail": out[-1500:]})})
             return  # one full capture is the goal; rerun manually for more
         time.sleep(POLL_INTERVAL_S)
 

@@ -105,6 +105,12 @@ class SwimConfig:
     # tests/test_sampling.py), but the iterative form avoids sorting an
     # [N, N] matrix per tick, which dominates the tick on TPU at large N.
     oldest_k_method: str = "iter"
+    # Compute the oldest-k candidates (eligibility + all k min-reduction
+    # rounds) in one fused Pallas pass over the state/timer tiles instead of
+    # k+1 jnp passes — bit-exact with the "iter" method (and so with stable
+    # top_k); single-device, N % 128 == 0, interpret-mode off TPU, like
+    # use_pallas_fp. bench.py enables it on the single-chip TPU path.
+    use_pallas_oldest_k: bool = False
 
     def __post_init__(self) -> None:
         if self.oldest_k_method not in ("topk", "iter"):

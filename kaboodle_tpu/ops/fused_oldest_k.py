@@ -1,0 +1,131 @@
+"""Pallas TPU kernel: fused oldest-k ping-target candidates in one HBM pass.
+
+The ping-target draw (kaboodle.rs:661-675: sort Known peers by last-heard,
+take the oldest 5, pick one) is, after the scatter-free mark rewrite, the
+tick's biggest remaining HBM consumer: the jnp ``iter`` formulation
+(ops/sampling._stable_k_smallest_iter) reads the ``[N, N]`` timer matrix once
+per round (k=5 rounds) plus an eligibility pass over ``state``. This kernel
+does the whole thing — eligibility (alive row, ``state == KNOWN``, not self),
+k rounds of lexicographic (timer, index) min-reduction — inside VMEM per row
+tile: ONE read of ``state`` (int8) and ``timer`` (int16/int32), no ``[N, N]``
+eligibility mask ever materialized.
+
+Bit-exact with ``_stable_k_smallest_iter`` over the same eligibility
+(asserted in tests/test_fused_oldest_k.py), hence with stable ``top_k``.
+
+Mosaic v5e constraints honored (see ops/fused_fp.py): all in-kernel vector
+compares/reductions run in int32 (sub-32-bit compares and unsigned
+reductions do not lower).
+
+Reference anchor: selection semantics kaboodle.rs:655-675; SWIM's
+round-robin-ish completeness bound derives from it (SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kaboodle_tpu.spec import KNOWN
+
+# Same per-input VMEM budget policy as ops/fused_fp.py.
+_VMEM_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _make_kernel(k: int, n: int):
+    def kernel(state_ref, timer_ref, alive_ref, out_idx_ref, out_valid_ref):
+        S = state_ref[:].astype(jnp.int32)  # [bn, N]
+        T = timer_ref[:].astype(jnp.int32)
+        alive = alive_ref[:].astype(jnp.int32)  # [bn, 1]
+        bn = S.shape[0]
+        base = pl.program_id(0) * bn
+        col = jax.lax.broadcasted_iota(jnp.int32, (bn, n), 1)
+        row = base + jax.lax.broadcasted_iota(jnp.int32, (bn, n), 0)
+        elig = (alive > 0) & (S == KNOWN) & (col != row)
+
+        NMAX = jnp.int32(jnp.iinfo(jnp.int32).max)
+        big_i = jnp.int32(n)
+        prev_t = jnp.full((bn, 1), jnp.iinfo(jnp.int32).min, jnp.int32)
+        prev_i = jnp.full((bn, 1), -1, jnp.int32)
+        for r in range(k):
+            after_prev = (T > prev_t) | ((T == prev_t) & (col > prev_i))
+            cand = elig & after_prev
+            t_r = jnp.min(jnp.where(cand, T, NMAX), axis=1, keepdims=True)
+            i_r = jnp.min(
+                jnp.where(cand & (T == t_r), col, big_i), axis=1, keepdims=True
+            )
+            out_idx_ref[:, r : r + 1] = jnp.minimum(i_r, n - 1)
+            out_valid_ref[:, r : r + 1] = (t_r != NMAX).astype(jnp.int32)
+            prev_t, prev_i = t_r, i_r
+
+    return kernel
+
+
+def pallas_oldest_k_supported(n: int) -> bool:
+    """Lane-aligned square state, like the fused fingerprint kernel."""
+    return n % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_oldest_k(
+    state: jax.Array,
+    timer: jax.Array,
+    alive: jax.Array,
+    k: int,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Per row: indices of the k smallest timers among eligible peers.
+
+    Eligibility is computed in-kernel: ``alive[i] & (state[i, j] == KNOWN) &
+    (j != i)`` — exactly the tick kernel's ping-target mask.
+
+    Args:
+      state: int8 ``[N, N]`` spec state codes.
+      timer: int16/int32 ``[N, N]`` last-heard ticks.
+      alive: bool ``[N]``.
+      k: candidate count (NUM_CANDIDATE_TARGET_PEERS; 1 in deterministic mode).
+
+    Returns ``(idx int32 [N, k], valid bool [N, k])`` — identical contract to
+    ops.sampling._stable_k_smallest_iter over the same eligibility.
+    """
+    n = state.shape[-1]
+    if not pallas_oldest_k_supported(n):
+        raise ValueError(f"fused_oldest_k needs N % 128 == 0, got {n}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # The kernel's live set is dominated by int32 working copies of the
+    # [bn, N] tiles (state+timer upcasts, iotas, round masks — ~8 of them),
+    # so budget 8 x int32 per cell; then take the largest sublane-aligned
+    # (multiple-of-8) EXACT divisor of n within budget, so there is never a
+    # padded partial last block.
+    budget = int(max(8, min(_VMEM_BLOCK_BYTES // (n * 8 * 4), 512, n)))
+    bn = 8
+    for cand in range(budget - budget % 8, 7, -8):
+        if n % cand == 0:
+            bn = cand
+            break
+    grid = ((n + bn - 1) // bn,)
+    row_block = lambda cells: pl.BlockSpec(  # noqa: E731
+        (bn, cells), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    idx, valid = pl.pallas_call(
+        _make_kernel(k, n),
+        grid=grid,
+        in_specs=[
+            row_block(n),
+            row_block(n),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(row_block(k), row_block(k)),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+        ),
+        interpret=interpret,
+    )(state, timer, alive.astype(jnp.int32)[:, None])
+    return idx, valid > 0

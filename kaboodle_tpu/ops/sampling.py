@@ -109,11 +109,26 @@ def choose_one_of_oldest_k(
         return jnp.where(valid[:, 0], idx[:, 0], -1).astype(jnp.int32)
     pick = _stable_k_smallest_iter if method == "iter" else _stable_k_smallest_topk
     idx, valid = pick(scores, k, tmax)
+    return choose_among_candidates(idx, valid, key, deterministic)
+
+
+def choose_among_candidates(
+    idx: jax.Array,
+    valid: jax.Array,
+    key: jax.Array,
+    deterministic: bool = False,
+) -> jax.Array:
+    """Uniform pick per row from ``(idx, valid)`` candidate lists ``[N, k]``.
+
+    The selection tail shared by every oldest-k formulation (jnp iter/topk
+    above, the fused Pallas kernel in ops/fused_oldest_k.py) — one draw per
+    row, so identical keys give identical picks across formulations. Returns
+    int32 ``[N]``, -1 where a row has no valid candidate."""
     count = jnp.sum(valid, axis=-1)  # [N]
     if deterministic:
-        choice = jnp.zeros(timer.shape[0], dtype=jnp.int32)
+        choice = jnp.zeros(idx.shape[0], dtype=jnp.int32)
     else:
-        u = jax.random.uniform(key, (timer.shape[0],))
+        u = jax.random.uniform(key, (idx.shape[0],))
         choice = jnp.floor(u * count.astype(jnp.float32)).astype(jnp.int32)
         choice = jnp.minimum(choice, jnp.maximum(count - 1, 0))
     chosen = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]

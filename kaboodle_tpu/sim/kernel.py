@@ -61,10 +61,12 @@ import jax.numpy as jnp
 
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
+from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k, pallas_oldest_k_supported
 from kaboodle_tpu.ops.hashing import peer_record_hash
 from kaboodle_tpu.ops.sampling import (
     bernoulli_matrix,
     broadcast_reply_prob,
+    choose_among_candidates,
     choose_k_members,
     choose_one_of_oldest_k,
 )
@@ -167,8 +169,12 @@ def make_tick_fn(
         # ---- delivery gate for every message this tick ------------------------
         # ok[s, d]: sender alive, receiver alive, same partition group, not
         # dropped. The lockstep oracle's ``delivery_ok`` + aliveness checks.
-        ok = alive[:, None] & alive[None, :]
+        # In fault-free mode the gate factors as alive[s] & alive[d], so no
+        # [N, N] matrix exists: edge checks are O(1) vector gathers
+        # (``ok_edge``) and the full-matrix consumers (join delivery) use the
+        # outer-product expression (``ok_outer``), which fuses.
         if faulty:
+            ok = alive[:, None] & alive[None, :]
             ok &= inp.partition[:, None] == inp.partition[None, :]
             if inp.drop_ok is not None:
                 ok &= inp.drop_ok
@@ -182,6 +188,19 @@ def make_tick_fn(
                     lambda ok: ok,
                     ok,
                 )
+
+            def ok_edge(s, d):
+                return _gather_edge(ok, s, d)
+
+            def ok_outer():  # ok[s, d] as a full matrix (join/fail delivery)
+                return ok
+        else:
+
+            def ok_edge(s, d):
+                return alive[jnp.clip(s, 0)] & alive[jnp.clip(d, 0)]
+
+            def ok_outer():
+                return alive[:, None] & alive[None, :]
 
         member0 = S > 0
         row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
@@ -300,11 +319,18 @@ def make_tick_fn(
         T = jnp.where(esc_cell, tT, T)
 
         # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
-        elig = alive[:, None] & (S == KNOWN) & ~eye
-        ping_tgt = choose_one_of_oldest_k(
-            T, elig, cfg.num_candidate_target_peers, key_ping, det,
-            method=cfg.oldest_k_method,
-        )
+        if cfg.use_pallas_oldest_k and pallas_oldest_k_supported(n):
+            # Fused path: eligibility + all k rounds in one pass over
+            # state/timer tiles — no [N, N] eligibility mask materialized.
+            kk = 1 if det else cfg.num_candidate_target_peers
+            cand_idx, cand_valid = fused_oldest_k(S, T, alive, kk)
+            ping_tgt = choose_among_candidates(cand_idx, cand_valid, key_ping, det)
+        else:
+            elig = alive[:, None] & (S == KNOWN) & ~eye
+            ping_tgt = choose_one_of_oldest_k(
+                T, elig, cfg.num_candidate_target_peers, key_ping, det,
+                method=cfg.oldest_k_method,
+            )
         has_ping = ping_tgt >= 0
         tgt_cell = _row_mark(idx, ping_tgt, has_ping)
         S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
@@ -329,7 +355,7 @@ def make_tick_fn(
         # Known(now) with the broadcast identity, preserving a prior latency
         # (kaboodle.rs:284-304, :291-297).
         if cfg.join_broadcast_enabled:
-            Jm = join_b[None, :] & ok.T & ~eye  # [receiver, origin]
+            Jm = join_b[None, :] & ok_outer().T & ~eye  # [receiver, origin]
             is_new_ro = Jm & ~member_a
             S = jnp.where(Jm, jnp.int8(KNOWN), S)
             T = jnp.where(Jm, tT, T)
@@ -349,8 +375,8 @@ def make_tick_fn(
             # union below.
             def _fail_del(_):
                 rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
-                fail_gt = _bool_matmul(ok.T, rem_gt)  # [r, j]
-                fail_any = _bool_matmul(ok.T, rem)  # [r, j]
+                fail_gt = _bool_matmul(ok_outer().T, rem_gt)  # [r, j]
+                fail_any = _bool_matmul(ok_outer().T, rem)  # [r, j]
                 return ~eye & jnp.where(Jm, fail_gt, fail_any)
 
             fail_del = jax.lax.cond(
@@ -379,7 +405,7 @@ def make_tick_fn(
             reply_p = broadcast_reply_prob(n_after)
             bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
             reply = is_new_ro & bern  # [r, o]
-            reply_del_ = reply & ok  # response unicast r -> o gated like any message
+            reply_del_ = reply & ok_outer()  # response unicast r -> o gated like any message
 
             # Gossip union at joiner o (deliverable in call 2): the reply share
             # is r's map at reply time = start-of-round map + joiners accepted
@@ -407,9 +433,9 @@ def make_tick_fn(
             reply_del = gossip = jnp.zeros((n, n), dtype=bool)
 
         # ================= Call 1: Pings + PingRequests =======================
-        ok_ping = has_ping & _gather_edge(ok, idx, ping_tgt)
-        ok_man = (man_tgt >= 0) & _gather_edge(ok, idx, man_tgt)
-        del_pr = proxies_valid & _gather_edge(ok, idx[:, None], proxies)  # [N, k]
+        ok_ping = has_ping & ok_edge(idx, ping_tgt)
+        ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
+        del_pr = proxies_valid & ok_edge(idx[:, None], proxies)  # [N, k]
 
         # mark1[dest, sender]: dense one-hot compares (no scatter) — each term
         # fuses into apply_marks' where pass. The proxy terms are all-False on
@@ -423,9 +449,9 @@ def make_tick_fn(
 
         # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
         # proxies' Pings to the suspect (kaboodle.rs:533-545).
-        del_ack = ok_ping & _gather_edge(ok, ping_tgt, idx)  # tgt -> pinger
-        del_ack_man = ok_man & _gather_edge(ok, man_tgt, idx)
-        ok_p2x = _gather_edge(ok, proxies, jstar[:, None])  # proxy -> suspect
+        del_ack = ok_ping & ok_edge(ping_tgt, idx)  # tgt -> pinger
+        del_ack_man = ok_man & ok_edge(man_tgt, idx)
+        ok_p2x = ok_edge(proxies, jstar[:, None])  # proxy -> suspect
         del_pping = del_pr & ok_p2x  # [N, k]
 
         # ================= Call 2: Acks, proxy Pings, join responses ==========
@@ -477,7 +503,7 @@ def make_tick_fn(
         )
 
         # Queued: the suspect's Acks back to the proxies.
-        del_pack = del_pping & _gather_edge(ok, jstar[:, None], proxies)  # [N, k]
+        del_pack = del_pping & ok_edge(jstar[:, None], proxies)  # [N, k]
 
         # Coincidence forwarding (kaboodle.rs:418-443 pop semantics): if proxy
         # p's own direct or manual ping this tick targeted the same suspect,
@@ -491,12 +517,12 @@ def make_tick_fn(
             (p_man == jstar[:, None]) & p_got_man
         )
         fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
-        del_fwd_c = fwd_c & _gather_edge(ok, proxies, idx[:, None])  # p -> suspector
+        del_fwd_c = fwd_c & ok_edge(proxies, idx[:, None])  # p -> suspector
 
         # Proxy forwards the suspect's Ack (fp2 payload) in call 4 unless the
         # curious entry was already popped by the call-2 coincidence.
         fwd = del_pack & ~pop_hit
-        del_fwd = fwd & _gather_edge(ok, proxies, idx[:, None])  # [N, k] p -> suspector
+        del_fwd = fwd & ok_edge(proxies, idx[:, None])  # [N, k] p -> suspector
 
         # ============ Calls 3 + 4: escalation-only delivery waves =============
         # Call 3: suspect Acks at proxies; call 4: forwarded Acks. Every
@@ -596,7 +622,7 @@ def make_tick_fn(
         partner = jnp.where(has_req, partner, -1)
 
         # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
-        del_kpr = has_req & _gather_edge(ok, idx, partner)
+        del_kpr = has_req & ok_edge(idx, partner)
         mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
         S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
 
@@ -606,7 +632,7 @@ def make_tick_fn(
         # post-marks, matching the oracle's two-pass delivery. Not capped (Q12).
         # Requests only flow while fingerprints disagree, so the share/gather/
         # insert passes are gated on one actually being delivered this tick.
-        del_rep = del_kpr & _gather_edge(ok, partner, idx)  # partner -> requester
+        del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
         # The share snapshot is taken before the requester-marks-partner write
         # below (the oracle's two-pass order): a partner's own fresh call-G
         # marks must not leak into the rows it shares this tick.

@@ -92,14 +92,36 @@ from kaboodle_tpu.sim.state import idle_inputs, init_state
 st = init_state(n, seed=0, track_latency=False, instant_identity=True,
                 timer_dtype=jnp.int16)
 inp = idle_inputs(n, ticks=8)
-for method in ("topk", "iter"):
-    cfg = SwimConfig(use_pallas_fp=True, oldest_k_method=method)
-    @jax.jit
-    def run(s, i, cfg=cfg):
-        o, _ = simulate(s, i, cfg, faulty=False)
-        return o.timer.sum() + o.tick
-    sec = fetch_timeit(run, st, inp, reps=2)
-    out[f"tick_{method}_ms"] = sec / 8 * 1e3
+variants = {
+    "topk": dict(use_pallas_fp=True, oldest_k_method="topk"),
+    "iter": dict(use_pallas_fp=True, oldest_k_method="iter"),
+}
+variants["nopallas"] = dict()
+try:
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
+    variants["fusedk"] = dict(use_pallas_fp=True, use_pallas_oldest_k=True)
+except ImportError:
+    pass
+for name, kw in variants.items():
+    try:
+        cfg = SwimConfig(**kw)
+        @jax.jit
+        def run(s, i, cfg=cfg):
+            o, _ = simulate(s, i, cfg, faulty=False)
+            return o.timer.sum() + o.tick
+        sec = fetch_timeit(run, st, inp, reps=2)
+        out[f"tick_{name}_ms"] = sec / 8 * 1e3
+    except Exception as e:
+        out[f"tick_{name}_error"] = repr(e)[:300]
+
+# What does the axon device report for memory accounting? (bench's
+# peak_hbm_mib came back null; record the raw keys so it can be fixed.)
+try:
+    stats = jax.local_devices()[0].memory_stats() or {}
+    out["memory_stats_keys"] = sorted(stats)[:20]
+    out["peak_bytes_in_use"] = stats.get("peak_bytes_in_use")
+except Exception as e:
+    out["memory_stats_error"] = repr(e)[:200]
 
 print("WATCHJSON " + json.dumps(out))
 """

@@ -1,0 +1,97 @@
+"""Fused Pallas oldest-k: bit-exactness against the jnp formulations.
+
+Runs in pallas interpreter mode on CPU (like tests/test_fused_fp.py); real
+Mosaic lowering is exercised on the chip by bench/tpu_watch.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k
+from kaboodle_tpu.ops.sampling import _stable_k_smallest_iter, choose_among_candidates
+from kaboodle_tpu.spec import KNOWN
+
+
+def _random_case(rng, n, timer_dtype):
+    state = rng.integers(0, 4, (n, n)).astype(np.int8)  # codes 0..3
+    timer = rng.integers(-12, 40, (n, n)).astype(timer_dtype)
+    alive = rng.random(n) < 0.8
+    return jnp.asarray(state), jnp.asarray(timer), jnp.asarray(alive)
+
+
+def _reference(state, timer, alive, k):
+    n = state.shape[-1]
+    eye = np.eye(n, dtype=bool)
+    elig = np.asarray(alive)[:, None] & (np.asarray(state) == KNOWN) & ~eye
+    tmax = jnp.asarray(np.iinfo(timer.dtype).max, dtype=timer.dtype)
+    scores = jnp.where(jnp.asarray(elig), timer, tmax)
+    return _stable_k_smallest_iter(scores, k, tmax)
+
+
+def test_fused_matches_iter_both_dtypes():
+    rng = np.random.default_rng(11)
+    for timer_dtype in (np.int16, np.int32):
+        for n in (128, 256):
+            state, timer, alive = _random_case(rng, n, timer_dtype)
+            for k in (1, 5):
+                fi, fv = fused_oldest_k(state, timer, alive, k, interpret=True)
+                ri, rv = _reference(state, timer, alive, k)
+                np.testing.assert_array_equal(np.asarray(fv), np.asarray(rv))
+                np.testing.assert_array_equal(
+                    np.where(np.asarray(fv), np.asarray(fi), -1),
+                    np.where(np.asarray(rv), np.asarray(ri), -1),
+                )
+
+
+def test_fused_non_pow2_lane_aligned_n():
+    """N=384: block size must divide N exactly (no padded partial block) —
+    the regression class where bn picked by VMEM budget alone left a
+    partial last block that never ran in any test."""
+    rng = np.random.default_rng(13)
+    state, timer, alive = _random_case(rng, 384, np.int16)
+    fi, fv = fused_oldest_k(state, timer, alive, 5, interpret=True)
+    ri, rv = _reference(state, timer, alive, 5)
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(rv))
+    np.testing.assert_array_equal(
+        np.where(np.asarray(fv), np.asarray(fi), -1),
+        np.where(np.asarray(rv), np.asarray(ri), -1),
+    )
+
+
+def test_fused_selection_identical_draws():
+    """Same key => same ping target through either formulation."""
+    rng = np.random.default_rng(5)
+    state, timer, alive = _random_case(rng, 128, np.int16)
+    key = jax.random.key(9)
+    fi, fv = fused_oldest_k(state, timer, alive, 5, interpret=True)
+    ri, rv = _reference(state, timer, alive, 5)
+    for det in (False, True):
+        a = choose_among_candidates(fi, fv, key, det)
+        b = choose_among_candidates(ri, rv, key, det)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_trajectory_with_fused_oldest_k():
+    """Whole-tick parity: use_pallas_oldest_k=True (interpret) must reproduce
+    the default kernel trajectory exactly, random and deterministic modes."""
+    from kaboodle_tpu.sim.runner import simulate
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    n, ticks = 128, 6
+    for det in (True, False):
+        base = SwimConfig(deterministic=det)
+        fused = SwimConfig(deterministic=det, use_pallas_oldest_k=True)
+        st = init_state(n, seed=2)
+        inp = idle_inputs(n, ticks=ticks)
+        out_a, m_a = simulate(st, inp, base, faulty=False)
+        out_b, m_b = simulate(st, inp, fused, faulty=False)
+        np.testing.assert_array_equal(np.asarray(out_a.state), np.asarray(out_b.state))
+        np.testing.assert_array_equal(np.asarray(out_a.timer), np.asarray(out_b.timer))
+        np.testing.assert_array_equal(
+            np.asarray(m_a.fingerprint_min), np.asarray(m_b.fingerprint_min)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_a.messages_delivered), np.asarray(m_b.messages_delivered)
+        )

@@ -1,0 +1,42 @@
+"""Smoke tests for the driver-facing benchmark helpers.
+
+bench.py is the artifact the driver runs on real hardware at end of round; a
+broken helper there silently costs a capture window, so the sections are
+exercised at tiny N here (full-size numbers come from the real runs).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _bench, _bench_churn, _bench_detection, _bench_gossip_boot  # noqa: E402
+
+
+def test_bench_throughput_section():
+    r = _bench(64, ticks=4)
+    assert r["converged"] and r["ticks_to_convergence"] >= 1
+    assert r["peers_ticks_per_sec"] > 0
+    assert r["state_variant"] == "full"  # below the lean threshold
+
+
+def test_bench_gossip_and_epidemic_sections():
+    (g,) = _bench_gossip_boot([48], max_ticks=2048)
+    (e,) = _bench_gossip_boot([48], max_ticks=256, backdate=False)
+    assert g["converged"] and e["converged"]
+    # Epidemic boot (no Q6 back-dating) must beat the reference-faithful
+    # gossip boot decisively — that is its whole point.
+    assert e["ticks_to_convergence"] < g["ticks_to_convergence"]
+
+
+def test_bench_churn_section():
+    r = _bench_churn(64, ticks=16)
+    assert r["peers_ticks_per_sec"] > 0
+    assert 0.0 <= r["final_agree_fraction"] <= 1.0
+
+
+def test_bench_detection_section():
+    r = _bench_detection(48)
+    assert r["first_removal_tick"] is not None
+    assert r["detection_complete_tick"] is not None
+    assert r["within_bound"], r

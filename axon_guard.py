@@ -1,0 +1,29 @@
+"""Strip the PYTHONPATH-injected accelerator plugin before importing jax.
+
+The tunneled TPU plugin (``/root/.axon_site`` on this rig) dials its device
+at *import* time; while the tunnel is wedged that hangs ``import jax``
+forever — before ``JAX_PLATFORMS=cpu`` or any config update can matter. Every
+CPU-only entry point (tests/conftest.py, the multichip dry run, the CPU
+smoke paths) calls :func:`strip_axon_plugin` before its first jax import.
+
+Must stay import-free of jax (and of kaboodle_tpu, whose package init
+imports jax).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_MARKER = ".axon_site"
+
+
+def strip_axon_plugin() -> None:
+    """Remove the plugin's path entries from ``sys.path`` and ``PYTHONPATH``.
+
+    Harmless if jax is already imported (the plugin import either happened or
+    it didn't) and idempotent."""
+    sys.path[:] = [p for p in sys.path if _MARKER not in p]
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if _MARKER not in p
+    )

@@ -10,16 +10,16 @@ this is still before any device materializes).
 
 import os
 import sys
+from pathlib import Path
 
-# The axon TPU plugin (injected via PYTHONPATH=/root/.axon_site) contacts the
-# device tunnel at import time; while the tunnel is wedged that import hangs
-# forever — which would hang `import jax` below even with JAX_PLATFORMS=cpu.
-# Tests never touch the real chip, so drop the plugin from the search path
-# before jax's plugin discovery can see it (must happen before `import jax`).
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = os.pathsep.join(
-    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if ".axon_site" not in p
-)
+# The axon TPU plugin contacts the device tunnel at import time; while the
+# tunnel is wedged that hangs `import jax` even with JAX_PLATFORMS=cpu.
+# Tests never touch the real chip — strip the plugin before jax's plugin
+# discovery can see it (shared guard; must run before `import jax`).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from axon_guard import strip_axon_plugin  # noqa: E402
+
+strip_axon_plugin()
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -384,9 +384,14 @@ def _pin_cpu() -> None:
     """Pin JAX to the CPU backend. Env alone is not enough: the interpreter's
     sitecustomize may have imported jax already with a pinned platform —
     update the live config too (backends are created lazily; same pattern as
-    tests/conftest.py)."""
+    tests/conftest.py). Strip the tunnel plugin first: a wedged tunnel can
+    hang `import jax` itself (axon_guard docstring), which is the very
+    outcome this fallback exists to avoid."""
     import os
 
+    from axon_guard import strip_axon_plugin
+
+    strip_axon_plugin()
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -530,6 +535,29 @@ def main() -> None:
         "churn_config3": churn,
         "detection_latency": detection,
     }
+    if fallback:
+        # The chip wedges for hours at a time (TPU_BENCH_NOTES.md); when this
+        # run could not reach it, attach the newest banked on-TPU capture
+        # (clearly labeled as such) so the round artifact still carries the
+        # hardware data point.
+        import glob
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        candidates = []
+        for path in glob.glob(os.path.join(root, "BENCH_r*_local.json")):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if str(data.get("backend", "")).startswith("tpu"):
+                    candidates.append((os.path.getmtime(path), path, data))
+            except (OSError, ValueError) as e:
+                print(f"bench: unreadable banked capture {path}: {e}",
+                      file=sys.stderr)
+        if candidates:
+            _, path, data = max(candidates)
+            line["banked_tpu_capture"] = {"source": os.path.basename(path), **data}
+        else:
+            print("bench: no banked on-TPU capture to attach", file=sys.stderr)
     print(json.dumps(line))
 
 

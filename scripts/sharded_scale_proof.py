@@ -31,7 +31,11 @@ def main() -> None:
     args = p.parse_args()
 
     # Pin the virtual-CPU platform before JAX can initialize any backend
-    # (same ordering contract as tests/conftest.py / __graft_entry__.py).
+    # (same ordering contract as tests/conftest.py / __graft_entry__.py),
+    # and strip the tunnel plugin, whose import hangs while wedged.
+    from axon_guard import strip_axon_plugin
+
+    strip_axon_plugin()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 

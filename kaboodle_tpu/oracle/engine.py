@@ -168,11 +168,15 @@ class PeerEngine:
         self._rng = random.Random(seed)
         # D2: sync candidates observed this tick, in arrival order.
         self._sync_candidates: list[tuple[object, int, int]] = []
-        # D5 bookkeeping (lockstep only): the membership snapshot at the start
-        # of the current broadcast round and the joins accepted during it.
-        # None => standalone use (real transport), where the trim falls back
-        # to the whole-map rule.
-        self._round_base: Optional[set] = None
+        # Lockstep-only bookkeeping: the membership snapshot (addr ->
+        # identity) at the start of the current broadcast round and the joins
+        # (addr, identity) accepted during it. Under the harness, join-reply
+        # shares are built from snapshot + joins-so-far (deviation D9: a
+        # same-tick Failed delivery does not retroactively shrink a share —
+        # the reference's outcome there depends on UDP arrival order, and
+        # this is the ordering the O(N^2) kernel implements). None =>
+        # standalone use (real transport): share the live map.
+        self._round_base: Optional[dict] = None
         self._round_joins: list = []
 
     # --- queries (lib.rs:301-354) -------------------------------------------
@@ -222,7 +226,21 @@ class PeerEngine:
         """Q5: join-triggered share — whole map, self included, no age filter
         (kaboodle.rs:362-369), trimmed to max_share_peers (kaboodle.rs:373-383
         trims randomly until the payload fits the 10 KiB buffer)."""
-        entries = [(a, r.identity) for a, r in self.known.items()]
+        if self._round_base is None:
+            entries = [(a, r.identity) for a, r in self.known.items()]
+        else:
+            # D9 (lockstep contract, aligned with the kernel's share_base /
+            # term2): start-of-round snapshot plus joins accepted so far —
+            # NOT the live map, which same-tick Failed deliveries may already
+            # have shrunk. Identities refresh from the live record when one
+            # exists (D-ID1).
+            merged = dict(self._round_base)
+            for a, ident in self._round_joins:
+                merged[a] = ident
+            entries = [
+                (a, self.known[a].identity if a in self.known else ident)
+                for a, ident in merged.items()
+            ]
         cap = self.cfg.max_share_peers
         if cap and len(entries) > cap:
             if self.cfg.deterministic:
@@ -240,7 +258,7 @@ class PeerEngine:
                         key=lambda e: addr_key(e[0]),
                     )[:cap]
                     base_addrs = {a for a, _ in base}
-                    joins = set(self._round_joins)
+                    joins = {a for a, _ in self._round_joins}
                     extra = [
                         e for e in entries
                         if e[0] in joins and e[0] not in base_addrs
@@ -447,7 +465,7 @@ class PeerEngine:
                 # D5 bookkeeping only under the lockstep harness (which resets
                 # both fields every round); a standalone engine must not
                 # accumulate join addresses forever.
-                self._round_joins.append(msg.addr)
+                self._round_joins.append((msg.addr, msg.identity))
             if is_new and self._should_respond_to_broadcast():
                 share = self._share_snapshot_join()
                 if share:

@@ -185,7 +185,7 @@ class LockstepMesh:
         # engine's D5 snapshot (start-of-round membership + joins accepted so
         # far) is what the aligned share-cap trims against.
         for eng in self.engines:
-            eng._round_base = set(eng.known)
+            eng._round_base = {a: r.identity for a, r in eng.known.items()}
             eng._round_joins = []
         join_responses = self._deliver_broadcasts(broadcasts, now)
 

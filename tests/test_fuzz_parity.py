@@ -1,0 +1,69 @@
+"""Randomized cross-engine fuzzing: random scenarios x random protocol flags.
+
+The hand-written parity suite (test_kernel_parity.py) pins specific
+transition paths; this file sweeps the *combination space* — random churn /
+partition / drop-mask / manual-ping schedules against randomly drawn config
+flags (boot mode, Q3/Q11 faithful-vs-intended, share caps, timer width,
+state variants) — and requires exact kernel == oracle state every tick.
+Seeds are fixed, so failures reproduce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.oracle.lockstep import LockstepMesh
+from kaboodle_tpu.sim.state import init_state
+from tests.test_kernel_parity import _inputs, _run_parity
+
+TICKS = 10
+
+
+def _random_cfg(rng) -> SwimConfig:
+    return SwimConfig(
+        deterministic=True,
+        join_broadcast_enabled=bool(rng.integers(2)),
+        backdate_gossip_inserts=bool(rng.integers(2)),
+        faithful_failed_broadcast=bool(rng.integers(2)),
+        faithful_indirect_ack=bool(rng.integers(2)),
+        max_share_peers=int(rng.choice([0, 6, 300])),
+    )
+
+
+def _random_inputs(rng, n, ticks):
+    seq = []
+    for _ in range(ticks):
+        kill = rng.random(n) < 0.06
+        revive = (rng.random(n) < 0.06) & ~kill
+        # Partitions: occasionally split into 2 groups for a few ticks.
+        part = (
+            (np.arange(n) % 2).astype(np.int32)
+            if rng.random() < 0.2
+            else np.zeros(n, np.int32)
+        )
+        # Deterministic drop mask (keeps oracle parity exact, unlike a rate).
+        drop_ok = rng.random((n, n)) >= rng.choice([0.0, 0.0, 0.15])
+        manual = np.where(rng.random(n) < 0.08, rng.integers(0, n, n), -1).astype(
+            np.int32
+        )
+        seq.append(
+            _inputs(n, kill=kill, revive=revive, partition=part, drop_ok=drop_ok,
+                    manual=manual)
+        )
+    return seq
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_scenario_random_flags(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(8, 20))
+    cfg = _random_cfg(rng)
+    ring = int(rng.integers(1, 3)) if not cfg.join_broadcast_enabled else 0
+    timer_dtype = jnp.int16 if rng.integers(2) else jnp.int32
+    st = init_state(n, seed=seed, ring_contacts=ring, timer_dtype=timer_dtype)
+    mesh = LockstepMesh(n, cfg, seed=seed, ring_contacts=ring)
+    _run_parity(mesh, st, _random_inputs(rng, n, TICKS), cfg=cfg)

@@ -207,7 +207,10 @@ def main() -> None:
                         continue
             log({"ts": time.time(), "kind": "bench", "rc": rc, "json": result,
                  **({} if result else {"tail": out[-1500:]})})
-            return  # one full capture is the goal; rerun manually for more
+            # Keep polling at a relaxed cadence: later windows yield fresh
+            # captures (the log keeps every one; readers take the newest).
+            time.sleep(3600)
+            continue
         time.sleep(POLL_INTERVAL_S)
 
 

@@ -67,7 +67,11 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
     from kaboodle_tpu.ops.fused_fp import pallas_supported
 
     use_pallas = jax.default_backend() == "tpu" and not sharded and pallas_supported(n)
-    cfg = SwimConfig(use_pallas_fp=use_pallas, use_pallas_oldest_k=use_pallas)
+    cfg = SwimConfig(
+        use_pallas_fp=use_pallas,
+        use_pallas_oldest_k=use_pallas,
+        use_pallas_suspicion=use_pallas,
+    )
     lean = n >= LEAN_STATE_MIN_N
     # int16 timers are only valid below ~32k ticks (init_state contract).
     # Budget for the adaptive timing floor too: the largest scan it can grow.

@@ -111,6 +111,12 @@ class SwimConfig:
     # top_k); single-device, N % 128 == 0, interpret-mode off TPU, like
     # use_pallas_fp. bench.py enables it on the single-chip TPU path.
     use_pallas_oldest_k: bool = False
+    # Compute the phase-A row statistics (membership count, timed-suspect
+    # argmin, proxy-candidate existence) in one fused Pallas pass over
+    # (state, timer) instead of 3-4 jnp passes — bit-exact
+    # (tests/test_fused_suspicion.py); same constraints as the other fused
+    # kernels. bench.py enables it on the single-chip TPU path.
+    use_pallas_suspicion: bool = False
 
     def __post_init__(self) -> None:
         if self.oldest_k_method not in ("topk", "iter"):

@@ -30,10 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kaboodle_tpu.ops.pallas_util import pick_row_block
 from kaboodle_tpu.spec import KNOWN
-
-# Same per-input VMEM budget policy as ops/fused_fp.py.
-_VMEM_BLOCK_BYTES = 2 * 1024 * 1024
 
 
 def _make_kernel(k: int, n: int):
@@ -103,12 +101,7 @@ def fused_oldest_k(
     # so budget 8 x int32 per cell; then take the largest sublane-aligned
     # (multiple-of-8) EXACT divisor of n within budget, so there is never a
     # padded partial last block.
-    budget = int(max(8, min(_VMEM_BLOCK_BYTES // (n * 8 * 4), 512, n)))
-    bn = 8
-    for cand in range(budget - budget % 8, 7, -8):
-        if n % cand == 0:
-            bn = cand
-            break
+    bn = pick_row_block(n)
     grid = ((n + bn - 1) // bn,)
     row_block = lambda cells: pl.BlockSpec(  # noqa: E731
         (bn, cells), lambda i: (i, 0), memory_space=pltpu.VMEM

@@ -1,0 +1,114 @@
+"""Pallas TPU kernel: fused phase-A row statistics in one HBM pass.
+
+Phase A of the tick (kaboodle.rs:746-757) needs four per-row reductions over
+the same two matrices before any state is written:
+
+  count[i]     = |{j : state[i,j] > 0}|                   (lonely test, A1)
+  has_timed[i] = any WaitingForPing cell aged >= timeout   (A2 suspicion)
+  jstar[i]     = that set's oldest cell, ties to lower j   (A2 escalation)
+  has_cand[i]  = any Known cell j != i                     (A2 proxy pool)
+
+As jnp these are 3-4 fused XLA passes over ``state`` + ``timer``; here they
+run inside VMEM per row tile — ONE read of each matrix for the whole phase.
+Bit-exact with the jnp formulation (tests/test_fused_suspicion.py, including
+whole-tick trajectory parity via SwimConfig.use_pallas_suspicion).
+
+Mosaic v5e constraints as in ops/fused_fp.py: all vector compares and
+reductions in int32.
+
+Reference anchors: lonely test kaboodle.rs:228-251; suspicion scan
+kaboodle.rs:558-605 (oldest timed-out WaitingForPing, D1 single escalation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kaboodle_tpu.ops.pallas_util import pick_row_block
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_PING
+
+
+def _make_kernel(n: int):
+    def kernel(state_ref, timer_ref, alive_ref, thr_ref,
+               cnt_ref, jstar_ref, timed_ref, cand_ref):
+        S = state_ref[:].astype(jnp.int32)  # [bn, N]
+        T = timer_ref[:].astype(jnp.int32)
+        alive = alive_ref[:].astype(jnp.int32) > 0  # [bn, 1]
+        thr = thr_ref[:]  # [bn, 1] int32: t - ping_timeout_ticks
+        bn = S.shape[0]
+        base = pl.program_id(0) * bn
+        col = jax.lax.broadcasted_iota(jnp.int32, (bn, n), 1)
+        row = base + jax.lax.broadcasted_iota(jnp.int32, (bn, n), 0)
+
+        cnt_ref[:] = jnp.sum((S > 0).astype(jnp.int32), axis=1, keepdims=True)
+
+        NMAX = jnp.int32(jnp.iinfo(jnp.int32).max)
+        timed = alive & (S == WAITING_FOR_PING) & (T <= thr)
+        t_min = jnp.min(jnp.where(timed, T, NMAX), axis=1, keepdims=True)
+        jstar = jnp.min(
+            jnp.where(timed & (T == t_min), col, jnp.int32(n)),
+            axis=1,
+            keepdims=True,
+        )
+        timed_any = (t_min != NMAX).astype(jnp.int32)
+        timed_ref[:] = timed_any
+        jstar_ref[:] = jnp.where(timed_any > 0, jnp.minimum(jstar, n - 1), -1)
+
+        cand = (S == KNOWN) & (col != row)
+        cand_ref[:] = jnp.max(cand.astype(jnp.int32), axis=1, keepdims=True)
+
+    return kernel
+
+
+def pallas_suspicion_supported(n: int) -> bool:
+    """Same shape rule as the other fused kernels."""
+    return n % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_suspicion(
+    state: jax.Array,
+    timer: jax.Array,
+    alive: jax.Array,
+    timed_threshold: jax.Array,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Phase-A row stats of ``(state, timer)`` in one fused pass.
+
+    Args:
+      state: int8 ``[N, N]`` spec state codes.
+      timer: int16/int32 ``[N, N]`` state-entry ticks.
+      alive: bool ``[N]``.
+      timed_threshold: int32 scalar ``t - ping_timeout_ticks`` — a
+        WaitingForPing cell is timed out iff ``timer <= timed_threshold``.
+
+    Returns ``(count int32 [N], jstar int32 [N] (-1 = none),
+    has_timed bool [N], has_cand bool [N])`` matching the tick kernel's jnp
+    formulation exactly (suspicion judged on alive rows only).
+    """
+    n = state.shape[-1]
+    if not pallas_suspicion_supported(n):
+        raise ValueError(f"fused_suspicion needs N % 128 == 0, got {n}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bn = pick_row_block(n)
+    grid = ((n + bn - 1) // bn,)
+    row_block = lambda cells: pl.BlockSpec(  # noqa: E731
+        (bn, cells), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    vec = jnp.broadcast_to(jnp.asarray(timed_threshold, jnp.int32), (n,))
+    cnt, jstar, timed, cand_ = pl.pallas_call(
+        _make_kernel(n),
+        grid=grid,
+        in_specs=[row_block(n), row_block(n), row_block(1), row_block(1)],
+        out_specs=(row_block(1),) * 4,
+        out_shape=tuple(jax.ShapeDtypeStruct((n, 1), jnp.int32) for _ in range(4)),
+        interpret=interpret,
+    )(state, timer, alive.astype(jnp.int32)[:, None], vec[:, None])
+    return cnt[:, 0], jstar[:, 0], timed[:, 0] > 0, cand_[:, 0] > 0

@@ -1,0 +1,25 @@
+"""Shared row-tiling policy for the int32-upcast fused Pallas kernels.
+
+fused_oldest_k and fused_suspicion upcast their [bn, N] tiles to int32 in
+VMEM and keep ~8 working copies live, so both budget 8 x int32 per cell and
+need bn to divide N exactly (no padded partial block — Mosaic pads reads,
+but a padded block would also run the reduction over garbage lanes whose
+outputs are then dropped; exact division keeps every block meaningful and
+was the fix for a pathological interpret-mode slowdown at non-power-of-two
+N). fused_fp keeps its own narrower policy (no int32 upcast of the wide
+input), proven on real hardware.
+"""
+
+from __future__ import annotations
+
+_VMEM_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def pick_row_block(n: int) -> int:
+    """Largest sublane-aligned (multiple-of-8) exact divisor of ``n`` whose
+    int32 working set (~8 copies of [bn, n]) fits the VMEM budget."""
+    budget = int(max(8, min(_VMEM_BLOCK_BYTES // (n * 8 * 4), 512, n)))
+    for cand in range(budget - budget % 8, 7, -8):
+        if n % cand == 0:
+            return cand
+    return 8

@@ -62,6 +62,7 @@ import jax.numpy as jnp
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
 from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k, pallas_oldest_k_supported
+from kaboodle_tpu.ops.fused_suspicion import fused_suspicion, pallas_suspicion_supported
 from kaboodle_tpu.ops.hashing import peer_record_hash
 from kaboodle_tpu.ops.sampling import (
     bernoulli_matrix,
@@ -202,8 +203,18 @@ def make_tick_fn(
             def ok_outer():
                 return alive[:, None] & alive[None, :]
 
-        member0 = S > 0
-        row_count0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
+        # Phase-A row stats. The fused path computes the membership count,
+        # the timed-out-suspect argmin, and proxy-candidate existence in one
+        # Pallas pass over (S, T); the jnp path spells the same formulas out
+        # (several fused XLA passes). S is not written between here and the
+        # A2 snapshot (A1 only touches broadcast bookkeeping vectors).
+        use_fused_susp = cfg.use_pallas_suspicion and pallas_suspicion_supported(n)
+        if use_fused_susp:
+            row_count0, jstar, has_timed, has_cand = fused_suspicion(
+                S, T, alive, t - cfg.ping_timeout_ticks
+            )
+        else:
+            row_count0 = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
         # Q6 insert stamp offset, shared by the join-gossip and anti-entropy
         # reply inserts (0 = the epidemic-boot extension, config.py).
         gossip_backdate = (
@@ -268,19 +279,22 @@ def make_tick_fn(
         # snapshot (the oracle iterates a snapshot taken at entry).
         S0, T0 = S, T
         age0 = t - T0
-        timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (age0 >= cfg.ping_timeout_ticks)
-        has_timed = jnp.any(timed_wfp, axis=-1)
-        # D1: escalate exactly one — the oldest, ties toward the lower index.
-        tsel = jnp.where(timed_wfp, T0, TMAX)
-        min_t = jnp.min(tsel, axis=-1)
-        jstar_mask = timed_wfp & (T0 == min_t[:, None])
-        jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
-        jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
+        if not use_fused_susp:
+            timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (
+                age0 >= cfg.ping_timeout_ticks
+            )
+            has_timed = jnp.any(timed_wfp, axis=-1)
+            # D1: escalate exactly one — the oldest, ties toward lower index.
+            tsel = jnp.where(timed_wfp, T0, TMAX)
+            min_t = jnp.min(tsel, axis=-1)
+            jstar_mask = timed_wfp & (T0 == min_t[:, None])
+            jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
+            jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
 
-        # Proxy candidates: Known peers other than self, from the same snapshot
-        # (kaboodle.rs:595-605; the suspect itself is WaitingForPing, excluded).
-        known_cand = (S0 == KNOWN) & ~eye
-        has_cand = jnp.any(known_cand, axis=-1)
+            # Proxy candidates: Known peers other than self, from the same
+            # snapshot (kaboodle.rs:595-605; the suspect itself is
+            # WaitingForPing, excluded).
+            has_cand = jnp.any((S0 == KNOWN) & ~eye, axis=-1)
         escalate = has_timed & has_cand
         insta_remove = has_timed & ~has_cand  # no proxies -> drop now (:599-605)
 
@@ -290,6 +304,9 @@ def make_tick_fn(
         # skip branch derives its shapes from the draw itself so the two
         # branches cannot drift apart.
         def _draw_proxies():
+            # The candidate matrix lives only inside this rare branch (the
+            # fused-suspicion path never materializes it outside).
+            known_cand = (S0 == KNOWN) & ~eye
             return choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det)
 
         proxies, proxies_valid = jax.lax.cond(

@@ -102,6 +102,13 @@ try:
     variants["fusedk"] = dict(use_pallas_fp=True, use_pallas_oldest_k=True)
 except ImportError:
     pass
+try:
+    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion  # noqa: F401
+    variants["fused_all"] = dict(
+        use_pallas_fp=True, use_pallas_oldest_k=True, use_pallas_suspicion=True
+    )
+except ImportError:
+    pass
 for name, kw in variants.items():
     try:
         cfg = SwimConfig(**kw)

@@ -1,0 +1,92 @@
+"""Fused Pallas phase-A stats: bit-exactness against the jnp formulation.
+
+Interpreter mode on CPU (like the other fused-kernel suites); real Mosaic
+lowering is exercised on the chip by bench/tpu_watch, and bench falls back
+to jnp if lowering fails.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.fused_suspicion import fused_suspicion
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_PING
+
+
+def _reference(state, timer, alive, thr):
+    S = np.asarray(state).astype(np.int32)
+    T = np.asarray(timer).astype(np.int32)
+    n = S.shape[0]
+    al = np.asarray(alive)
+    count = (S > 0).sum(axis=1).astype(np.int32)
+    timed = al[:, None] & (S == WAITING_FOR_PING) & (T <= int(thr))
+    has_timed = timed.any(axis=1)
+    jstar = np.full(n, -1, np.int32)
+    for i in range(n):
+        if has_timed[i]:
+            cols = np.nonzero(timed[i])[0]
+            jstar[i] = cols[np.argmin(T[i, cols])]  # first min = lowest index
+    eye = np.eye(n, dtype=bool)
+    has_cand = ((S == KNOWN) & ~eye).any(axis=1)
+    return count, jstar, has_timed, has_cand
+
+
+def test_fused_matches_reference():
+    rng = np.random.default_rng(21)
+    for timer_dtype in (np.int16, np.int32):
+        for n in (128, 384):
+            state = jnp.asarray(rng.integers(0, 4, (n, n)).astype(np.int8))
+            timer = jnp.asarray(rng.integers(-12, 30, (n, n)).astype(timer_dtype))
+            alive = jnp.asarray(rng.random(n) < 0.85)
+            thr = 9
+            fc, fj, ft, fk = fused_suspicion(state, timer, alive, thr, interpret=True)
+            rc, rj, rt, rk = _reference(state, timer, alive, thr)
+            np.testing.assert_array_equal(np.asarray(fc), rc)
+            np.testing.assert_array_equal(np.asarray(ft), rt)
+            np.testing.assert_array_equal(np.asarray(fk), rk)
+            np.testing.assert_array_equal(np.asarray(fj), rj)
+
+
+def test_kernel_trajectory_with_fused_suspicion():
+    """Whole-tick parity under drops heavy enough to force escalations: the
+    fused phase-A stats must reproduce the default kernel trajectory
+    exactly, including suspicion -> indirect ping -> removal."""
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import init_state
+    from tests.test_kernel_parity import _inputs
+
+    import jax
+
+    n, ticks = 128, 8
+    rng = np.random.default_rng(3)
+    # Block most acks so WaitingForPing entries time out and escalate.
+    seq = [
+        _inputs(n, drop_ok=rng.random((n, n)) >= 0.5)
+        for _ in range(ticks)
+    ]
+    for det in (True, False):
+        base_cfg = SwimConfig(deterministic=det)
+        fused_cfg = SwimConfig(deterministic=det, use_pallas_suspicion=True)
+        tick_a = jax.jit(make_tick_fn(base_cfg, faulty=True))
+        tick_b = jax.jit(make_tick_fn(fused_cfg, faulty=True))
+        st_a = init_state(n, seed=7)
+        st_b = init_state(n, seed=7)
+        escalated = False
+        for i, inp in enumerate(seq):
+            st_a, m_a = tick_a(st_a, inp)
+            st_b, m_b = tick_b(st_b, inp)
+            np.testing.assert_array_equal(
+                np.asarray(st_a.state), np.asarray(st_b.state),
+                err_msg=f"state mismatch at tick {i} (det={det})",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(st_a.timer), np.asarray(st_b.timer),
+                err_msg=f"timer mismatch at tick {i} (det={det})",
+            )
+            assert int(m_a.messages_delivered) == int(m_b.messages_delivered)
+            escalated |= (np.asarray(st_a.state) == 3).any()
+        # The scenario must actually exercise the escalation path — without
+        # WaitingForIndirectPing entries the fused jstar/has_cand outputs
+        # would never be consequential and this parity test would prove
+        # nothing about them.
+        assert escalated, "drop scenario produced no escalations; re-tune it"

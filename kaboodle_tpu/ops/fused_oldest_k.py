@@ -11,7 +11,9 @@ tile: ONE read of ``state`` (int8) and ``timer`` (int16/int32), no ``[N, N]``
 eligibility mask ever materialized.
 
 Bit-exact with ``_stable_k_smallest_iter`` over the same eligibility
-(asserted in tests/test_fused_oldest_k.py), hence with stable ``top_k``.
+(asserted in tests/test_fused_oldest_k.py), hence with stable ``top_k`` —
+including the sentinel edge: a timer equal to the timer dtype's max is
+treated as invalid, exactly as the jnp path's masking makes it.
 
 Mosaic v5e constraints honored (see ops/fused_fp.py): all in-kernel vector
 compares/reductions run in int32 (sub-32-bit compares and unsigned
@@ -34,7 +36,7 @@ from kaboodle_tpu.ops.pallas_util import pick_row_block
 from kaboodle_tpu.spec import KNOWN
 
 
-def _make_kernel(k: int, n: int):
+def _make_kernel(k: int, n: int, tmax: int):
     def kernel(state_ref, timer_ref, alive_ref, out_idx_ref, out_valid_ref):
         S = state_ref[:].astype(jnp.int32)  # [bn, N]
         T = timer_ref[:].astype(jnp.int32)
@@ -43,7 +45,15 @@ def _make_kernel(k: int, n: int):
         base = pl.program_id(0) * bn
         col = jax.lax.broadcasted_iota(jnp.int32, (bn, n), 1)
         row = base + jax.lax.broadcasted_iota(jnp.int32, (bn, n), 0)
-        elig = (alive > 0) & (S == KNOWN) & (col != row)
+        # A timer at the dtype's max is indistinguishable from the jnp
+        # formulation's ineligibility sentinel (sampling.choose_one_of_oldest_k
+        # masks with iinfo(timer.dtype).max), so it is invalid there; exclude
+        # it here too so the two stay bit-exact without relying on the
+        # timers-below-dtype-max contract (init_state enforces it, but the
+        # kernel must not silently depend on its callers).
+        elig = (
+            (alive > 0) & (S == KNOWN) & (col != row) & (T != jnp.int32(tmax))
+        )
 
         NMAX = jnp.int32(jnp.iinfo(jnp.int32).max)
         big_i = jnp.int32(n)
@@ -107,7 +117,7 @@ def fused_oldest_k(
         (bn, cells), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     idx, valid = pl.pallas_call(
-        _make_kernel(k, n),
+        _make_kernel(k, n, int(jnp.iinfo(timer.dtype).max)),
         grid=grid,
         in_specs=[
             row_block(n),

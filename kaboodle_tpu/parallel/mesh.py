@@ -75,16 +75,19 @@ def make_multihost_mesh(
         import os
 
         platforms = os.environ.get("JAX_PLATFORMS", "").lower()
-        on_tpu_pod = "tpu" in platforms or "TPU_WORKER_HOSTNAMES" in os.environ
-        if not on_tpu_pod:
+        cpu_run = "cpu" in [p.strip() for p in platforms.split(",") if p]
+        if cpu_run:
             # CPU clusters (the multi-host test rig, tests/test_multihost.py,
             # or any CPU-only multi-machine run) need an explicit
             # cross-process collectives implementation — without one the
-            # first collective hangs. TPU pods bring their own (ICI/DCN) and
-            # must not see this; nothing backend-touching may run before
-            # initialize(), so detection is env-only: enable gloo unless a
-            # TPU platform/pod marker is present (jax defaults to CPU when
-            # JAX_PLATFORMS is unset and no accelerator is found).
+            # first collective hangs. Nothing backend-touching may run before
+            # initialize(), so detection is env-only, and positive: only a
+            # run that *explicitly* pins JAX_PLATFORMS=cpu gets gloo
+            # (bring-your-own CPU clusters must set it — multihost_worker.py
+            # does). Accelerator pods bring their own collectives (ICI/DCN)
+            # and never match; the flag would be a no-op for them anyway
+            # (it only affects the CPU backend), but a positive gate beats
+            # relying on that.
             try:
                 jax.config.update("jax_cpu_collectives_implementation", "gloo")
             except AttributeError:  # renamed/absent in other jax versions

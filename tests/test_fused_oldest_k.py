@@ -12,6 +12,7 @@ from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k
 from kaboodle_tpu.ops.sampling import _stable_k_smallest_iter, choose_among_candidates
 from kaboodle_tpu.spec import KNOWN
+import pytest
 
 
 def _random_case(rng, n, timer_dtype):
@@ -30,6 +31,7 @@ def _reference(state, timer, alive, k):
     return _stable_k_smallest_iter(scores, k, tmax)
 
 
+@pytest.mark.slow
 def test_fused_matches_iter_both_dtypes():
     rng = np.random.default_rng(11)
     for timer_dtype in (np.int16, np.int32):
@@ -43,6 +45,32 @@ def test_fused_matches_iter_both_dtypes():
                     np.where(np.asarray(fv), np.asarray(fi), -1),
                     np.where(np.asarray(rv), np.asarray(ri), -1),
                 )
+
+
+def test_fused_timer_at_dtype_max_is_invalid():
+    """A real timer pinned at the timer dtype's max must be invalid in both
+    formulations: the jnp path cannot tell it from the ineligibility sentinel,
+    and the fused kernel excludes it explicitly (ADVICE r3: bit-exactness must
+    not hinge on the timers-below-dtype-max contract)."""
+    rng = np.random.default_rng(17)
+    for timer_dtype in (np.int16, np.int32):
+        state, timer, alive = _random_case(rng, 128, timer_dtype)
+        tmax = np.iinfo(timer_dtype).max
+        timer = np.asarray(timer).copy()
+        # Pin whole rows' eligible cells at tmax (row 0) and a scattering.
+        timer[0, :] = tmax
+        timer[1, ::3] = tmax
+        timer = jnp.asarray(timer)
+        state = state.at[0, :].set(KNOWN)  # eligible but tmax -> invalid
+        alive = alive.at[0].set(True)
+        fi, fv = fused_oldest_k(state, timer, alive, 5, interpret=True)
+        ri, rv = _reference(state, timer, alive, 5)
+        np.testing.assert_array_equal(np.asarray(fv), np.asarray(rv))
+        assert not np.asarray(fv)[0].any()  # all-tmax row: nothing valid
+        np.testing.assert_array_equal(
+            np.where(np.asarray(fv), np.asarray(fi), -1),
+            np.where(np.asarray(rv), np.asarray(ri), -1),
+        )
 
 
 def test_fused_non_pow2_lane_aligned_n():
@@ -73,6 +101,7 @@ def test_fused_selection_identical_draws():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_kernel_trajectory_with_fused_oldest_k():
     """Whole-tick parity: use_pallas_oldest_k=True (interpret) must reproduce
     the default kernel trajectory exactly, random and deterministic modes."""

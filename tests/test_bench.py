@@ -11,8 +11,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from bench import _bench, _bench_churn, _bench_detection, _bench_gossip_boot  # noqa: E402
+import pytest
 
 
+@pytest.mark.slow
 def test_bench_throughput_section():
     r = _bench(64, ticks=4)
     assert r["converged"] and r["ticks_to_convergence"] >= 1
@@ -20,6 +22,7 @@ def test_bench_throughput_section():
     assert r["state_variant"] == "full"  # below the lean threshold
 
 
+@pytest.mark.slow
 def test_bench_gossip_and_epidemic_sections():
     (g,) = _bench_gossip_boot([48], max_ticks=2048)
     (e,) = _bench_gossip_boot([48], max_ticks=256, backdate=False)
@@ -29,12 +32,14 @@ def test_bench_gossip_and_epidemic_sections():
     assert e["ticks_to_convergence"] < g["ticks_to_convergence"]
 
 
+@pytest.mark.slow
 def test_bench_churn_section():
     r = _bench_churn(64, ticks=16)
     assert r["peers_ticks_per_sec"] > 0
     assert 0.0 <= r["final_agree_fraction"] <= 1.0
 
 
+@pytest.mark.slow
 def test_bench_detection_section():
     r = _bench_detection(48)
     assert r["first_removal_tick"] is not None

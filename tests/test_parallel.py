@@ -45,6 +45,7 @@ def _assert_states_equal(a, b):
 
 
 @pytest.mark.parametrize("deterministic", [True, False])
+@pytest.mark.slow
 def test_sharded_simulate_matches_single_device(mesh8, deterministic):
     n, ticks = 32, 12
     cfg = SwimConfig(deterministic=deterministic)
@@ -66,6 +67,7 @@ def test_sharded_simulate_matches_single_device(mesh8, deterministic):
 
 @pytest.mark.parametrize("track_latency", [True, False])
 @pytest.mark.parametrize("instant_identity", [True, False])
+@pytest.mark.slow
 def test_sharded_optional_fields_all_combinations(mesh8, track_latency, instant_identity):
     """The optional [N, N] fields (latency, id_view) must shard as
     P('peers', None) when present and stay None when absent — in all four
@@ -97,6 +99,7 @@ def test_sharded_optional_fields_all_combinations(mesh8, track_latency, instant_
         assert sh_final.latency.sharding.is_equivalent_to(row_sharded, 2)
 
 
+@pytest.mark.slow
 def test_sharded_faulty_path_matches_single_device(mesh8):
     """Churn + partition + explicit drop mask through the sharded kernel."""
     n, ticks = 24, 10
@@ -120,6 +123,7 @@ def test_sharded_faulty_path_matches_single_device(mesh8):
     assert jnp.array_equal(ref_m.messages_delivered, sh_m.messages_delivered)
 
 
+@pytest.mark.slow
 def test_sharded_convergence_matches_and_is_sharded(mesh8):
     n = 32
     cfg = SwimConfig()
@@ -152,3 +156,19 @@ def test_multihost_mesh_single_process_fallback():
     mesh = make_multihost_mesh()
     assert mesh.axis_names == ("peers",)
     assert mesh.size == len(jax.devices())
+
+
+@pytest.mark.slow
+def test_sharded_epidemic_boot_converges(mesh8):
+    """Behavioral GSPMD proof at CI scale (VERDICT r3 item 5): a broadcast-free
+    epidemic boot (ring contacts, fresh gossip stamps) must *converge* under
+    the sharded program — the per-shard fingerprint reduction + peer-axis
+    all-reduce agreeing — not merely execute sharded. The full-scale version
+    is scripts/sharded_scale_proof.py --boot epidemic."""
+    n = 256
+    cfg = SwimConfig(join_broadcast_enabled=False, backdate_gossip_inserts=False)
+    st = shard_state(init_state(n, seed=0, ring_contacts=2), mesh8)
+    final, ticks, conv = run_until_converged_sharded(st, cfg, mesh8, max_ticks=256)
+    assert bool(conv), "epidemic boot did not converge under GSPMD"
+    assert 1 < int(ticks) < 256  # genuinely epidemic, not broadcast-instant
+    assert len(final.state.sharding.device_set) == 8

@@ -7,10 +7,16 @@ PORT ?= 7475
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+# Core lane for the edit-test loop: everything not marked `slow` (the heavy
+# end-to-end/parity-at-scale lanes). CI always runs the full `test` lane.
+test-quick:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
 # Dependency-free AST lint (undefined names, unused imports) — the clippy
 # `-D warnings` analogue (reference main.yml:48-52); see scripts/lint.py.
 lint:
 	$(PYTHON) scripts/lint.py
+	$(PYTHON) scripts/license_check.py
 
 native:
 	$(MAKE) -C native
@@ -41,10 +47,24 @@ ci: lint native test
 	timeout 420 $(PYTHON) __graft_entry__.py
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 
-# Sharded scale proof: N=8192 over 8 virtual CPU devices, wall-clock and
-# peak-RSS logged (VERDICT r2 item 6). Not part of `ci` by default — ~minutes.
+# Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
+# then the every-fault-path scan, N=8192 over 8 virtual CPU devices,
+# wall-clock + peak RSS logged. Not part of `ci` by default — ~minutes.
 scale-proof:
-	$(PYTHON) scripts/sharded_scale_proof.py --n 8192 --devices 8 --ticks 8
+	$(PYTHON) scripts/sharded_scale_proof.py --n 8192 --devices 8 --ticks 8 --boot epidemic
+
+# North-star scale (BASELINE configs 4-5): N=65,536 lean+int16 sharded,
+# broadcast boot to asserted convergence + steady-state faulty ticks with
+# peak RSS against MEMORY_PLAN.md. Drop stays off: the [N, N] uniform draw
+# alone is 16 GiB at this N. ~an hour on a single-core host.
+scale-proof-65k:
+	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 4 \
+	  --boot broadcast --boot-max-ticks 8 --drop-rate 0
+
+# Two-machine real-network demo (reference justfile:57-78 analogue); see
+# scripts/cross_host.sh for the interface-selection rules.
+cross-host:
+	./scripts/cross_host.sh
 
 clean:
 	$(MAKE) -C native clean

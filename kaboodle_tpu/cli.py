@@ -105,6 +105,41 @@ def format_peer_table(self_addr: str, peer_states: dict, peers: dict) -> str:
     return "\n".join(lines)
 
 
+# Interactive-mode spinner (main.rs:134-136, 226-244): a braille-dot cycle —
+# the standard "dots" spinner — shown only when stdin is a terminal.
+_SPINNER_FRAMES = "⣾⣽⣻⢿⡿⣟⣯⣷"
+
+
+def _spawn_stdin_reader():
+    """Daemon thread feeding stdin chars to a queue (main.rs:247-258): in
+    interactive mode, typing anything (terminal line discipline applies, so
+    followed by Enter) triggers a full status dump."""
+    import queue
+    import threading
+
+    q: "queue.Queue[str]" = queue.Queue()
+
+    def reader() -> None:
+        while True:
+            ch = sys.stdin.read(1)
+            if not ch:  # EOF
+                return
+            q.put(ch)
+
+    threading.Thread(target=reader, daemon=True).start()
+    return q
+
+
+def _print_status(node, self_addr: str) -> None:
+    """Fingerprint + peer table + terminal title (main.rs:179-225)."""
+    states = node.peer_states()
+    fp = node.fingerprint()
+    # Terminal title: "{addr} {n} {fp:08x}" (main.rs:189-192).
+    sys.stdout.write(f"\x1b]0;{self_addr} {len(states)} {fp:08x}\x07")
+    print(f"{len(states)} peers, fingerprint {fp:08x}")
+    print(format_peer_table(self_addr, states, node.peers()))
+
+
 def run_real(args) -> int:
     from kaboodle_tpu.transport import RealKaboodle, discover_mesh_member
 
@@ -138,23 +173,81 @@ def run_real(args) -> int:
     node.ping_addrs(args.ping)
     self_addr = node.self_addr()
     print(f"self: {self_addr} on {ip} (port {args.port})")
+
+    # Event-driven output (main.rs:144-225): joins/leaves print as they
+    # arrive; the full status block only on fingerprint change or a
+    # keypress. The spinner runs only when stdin is a terminal.
+    discovery = node.discover_peers()
+    departures = node.discover_departures()
+    fp_changes = node.discover_fingerprint_changes()
+    # Both ends must be terminals: stdin for the dump trigger (don't steal
+    # keystrokes a pipeline owns), stdout for the animation (don't corrupt
+    # piped/teed output with \r frames).
+    spin = sys.stdin.isatty() and sys.stdout.isatty()
+    stdin_q = _spawn_stdin_reader() if spin else None
+    frame = 0
     deadline = time.time() + args.duration if args.duration else None
+    period_s = min(args.period_ms / 1000.0, 1.0)
     try:
         while deadline is None or time.time() < deadline:
-            time.sleep(min(args.period_ms / 1000.0, 1.0))
+            # One smooth spinner rotation per period beats one frame per
+            # second (the protocol only does work once a period, the
+            # animation shouldn't look like it hung — main.rs:226-237).
+            if spin:
+                for _ in range(10):
+                    sys.stdout.write(f"\r{_SPINNER_FRAMES[frame]} ")
+                    sys.stdout.flush()
+                    frame = (frame + 1) % len(_SPINNER_FRAMES)
+                    time.sleep(period_s / 10)
+            else:
+                time.sleep(period_s)
             node.poll_events()
-            states = node.peer_states()
-            fp = node.fingerprint()
-            # Terminal title: "{addr} {n} {fp:08x}" (main.rs:189-192).
-            sys.stdout.write(f"\x1b]0;{self_addr} {len(states)} {fp:08x}\x07")
-            print(f"\n{len(states)} peers, fingerprint {fp:08x}")
-            print(format_peer_table(self_addr, states, node.peers()))
+
+            emitted = False
+
+            def clear_spinner() -> None:
+                nonlocal emitted
+                if spin and not emitted:
+                    sys.stdout.write("\r  \r")  # erase the spinner frame
+                    emitted = True
+
+            while discovery:
+                addr, ident = discovery.popleft()
+                clear_spinner()
+                ident_s = ident.decode("utf-8", "replace")
+                print(f"+ {addr}" + (f" ({ident_s})" if ident_s else ""))
+            while departures:
+                clear_spinner()
+                print(f"- {departures.popleft()}")
+            new_fp = None
+            while fp_changes:  # drain: only the newest value matters
+                new_fp = fp_changes.popleft()
+            dump = False
+            if stdin_q is not None:
+                while not stdin_q.empty():
+                    stdin_q.get_nowait()
+                    dump = True
+            if new_fp is not None or dump:
+                clear_spinner()
+                _print_status(node, self_addr)
     except KeyboardInterrupt:
         pass
     finally:
-        if node.is_running:
-            node.stop()
-        node.close()
+        try:
+            # Exit summary: the final fingerprint/table even if the last
+            # change predated the last period (keeps demo2's interleaved
+            # output meaningful and the live test's final lines comparable).
+            # Best-effort: never let a dead engine's status dump shadow the
+            # exception that actually ended the loop.
+            if spin:
+                sys.stdout.write("\r  \r")
+            _print_status(node, self_addr)
+        except Exception:
+            pass
+        finally:
+            if node.is_running:
+                node.stop()
+            node.close()
     return 0
 
 

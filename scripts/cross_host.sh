@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Two-machine real-network demo — the tailscale-recipe analogue
+# (reference justfile:57-78). Run this on EACH machine on the same L2
+# segment or tailnet; the instances find each other with zero configuration
+# via the transport's IPv6 link-local multicast group (ff02::1213:1989, the
+# reference's group) or IPv4 broadcast.
+#
+#   ./scripts/cross_host.sh                  # auto: prefer tailscale v6, else v6, else v4
+#   ./scripts/cross_host.sh v4               # force IPv4 broadcast
+#   ./scripts/cross_host.sh 100.x.y.z        # bind an explicit address
+#   ./scripts/cross_host.sh v6 --probe       # one-shot mesh probe instead of joining
+#
+# Extra args after the interface spec pass through to the CLI
+# (`python -m kaboodle_tpu --help` for the list: --port, --identity,
+# --period-ms, --ping, --probe, --duration ...).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SPEC="${1:-}"
+[ $# -gt 0 ] && shift
+
+if [ -z "${SPEC}" ]; then
+    # Prefer the tailscale IPv6 address when a tailnet is up — same
+    # preference as the reference's `just tailscale` recipe — otherwise let
+    # the CLI's own best-interface selection pick (v6 first, then v4).
+    if hash tailscale 2>/dev/null; then
+        TS_ADDR="$(tailscale ip --6 2>/dev/null || true)"
+        if [ -n "${TS_ADDR}" ]; then
+            SPEC="${TS_ADDR}"
+            echo "cross-host: using tailscale IPv6 ${SPEC}" >&2
+        fi
+    fi
+fi
+
+make -s native
+if [ -n "${SPEC}" ]; then
+    exec python -m kaboodle_tpu --interface "${SPEC}" "$@"
+else
+    exec python -m kaboodle_tpu "$@"
+fi

@@ -1,0 +1,110 @@
+"""Dependency-license gate — the cargo-deny `check licenses` analogue
+(reference .github/workflows/main.yml:55-62, deny.toml).
+
+The runtime dependency surface is deliberately tiny (pyproject.toml: jax,
+numpy, plus the optional test extra), so the gate is a direct metadata
+check: every installed dependency in the transitive closure of our declared
+deps must carry an allowed (permissive) license. Fails the build on a
+missing or non-permissive license, exactly like cargo-deny's deny-by-default
+posture. No network, no extra tooling — importlib.metadata only.
+"""
+
+from __future__ import annotations
+
+import sys
+from importlib import metadata
+
+# Permissive licenses this project accepts (deny.toml listed the SPDX ids
+# the reference allowed; the Python ecosystem spells them many ways).
+ALLOWED_SUBSTRINGS = (
+    "apache",
+    "bsd",
+    "mit",
+    "psf",
+    "python software foundation",
+    "isc",
+    "unlicense",
+    "mpl",  # weak copyleft: allowed as the reference's deny.toml allowed MPL-2.0
+    "zlib",
+    "public domain",
+)
+
+# Declared runtime + test deps (pyproject.toml); their transitive closure is
+# resolved live from installed metadata.
+ROOTS = ["jax", "numpy", "pytest", "hypothesis"]
+
+
+def _license_of(dist: metadata.Distribution) -> str:
+    md = dist.metadata
+    lic = (md.get("License-Expression") or md.get("License") or "").strip()
+    # Many wheels leave License empty/UNKNOWN and use trove classifiers.
+    if not lic or lic.upper() == "UNKNOWN" or len(lic) > 200:
+        for cl in md.get_all("Classifier") or []:
+            if cl.startswith("License ::"):
+                lic = cl.split("::")[-1].strip()
+                break
+    return lic
+
+
+def _requires(name: str) -> list[str]:
+    try:
+        reqs = metadata.requires(name) or []
+    except metadata.PackageNotFoundError:
+        return []
+    out = []
+    for r in reqs:
+        try:
+            from packaging.requirements import Requirement
+
+            req = Requirement(r)
+            # Evaluate plain environment markers for THIS interpreter (a
+            # python_version-gated dep that is installed here must be
+            # checked); only extra-gated deps are skipped — we install none.
+            if req.marker is not None and not req.marker.evaluate({"extra": ""}):
+                continue
+            out.append(req.name)
+        except Exception:
+            # No packaging / unparsable requirement: fall back to a bare
+            # name split, keeping markerless requirements only.
+            if ";" in r:
+                continue
+            for sep in "<>=!~ ([":
+                r = r.split(sep)[0]
+            if r:
+                out.append(r.strip())
+    return out
+
+
+def main() -> int:
+    seen: dict[str, str] = {}
+    stack = list(ROOTS)
+    while stack:
+        name = stack.pop()
+        key = name.lower()
+        if key in seen:
+            continue
+        try:
+            dist = metadata.distribution(name)
+        except metadata.PackageNotFoundError:
+            continue  # optional extra not installed in this environment
+        seen[key] = _license_of(dist)
+        stack.extend(_requires(name))
+
+    bad = {
+        name: lic or "<missing>"
+        for name, lic in sorted(seen.items())
+        if not any(s in lic.lower() for s in ALLOWED_SUBSTRINGS)
+    }
+    for name, lic in sorted(seen.items()):
+        mark = "FAIL" if name in bad else "ok"
+        print(f"{mark:4} {name}: {lic or '<missing>'}")
+    if bad:
+        print(f"\nlicense check FAILED for {len(bad)} package(s): "
+              f"{', '.join(bad)}", file=sys.stderr)
+        return 1
+    print(f"\nlicense check ok: {len(seen)} packages, all permissive")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,14 +84,14 @@ val = jnp.ones((n,), bool)
 out["scatter_mark_ms"] = fetch_timeit(scatter_mark, tgt, val) * 1e3
 out["onehot_mark_ms"] = fetch_timeit(onehot_mark, tgt, val) * 1e3
 
-# Whole-tick A/B at N=16384, lean+int16, fault-free (the bench configuration).
+# Whole-tick A/B, lean+int16, fault-free (the bench configuration), at the
+# round-3 capture size AND the single-chip ceiling (VERDICT r4 item 1:
+# the fused-kernel story needs measured ms/tick at 16,384 and 32,768
+# against the 10-20 ms HBM roofline floor, PERF.md).
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.sim.runner import simulate
 from kaboodle_tpu.sim.state import idle_inputs, init_state
 
-st = init_state(n, seed=0, track_latency=False, instant_identity=True,
-                timer_dtype=jnp.int16)
-inp = idle_inputs(n, ticks=8)
 variants = {
     "topk": dict(use_pallas_fp=True, oldest_k_method="topk"),
     "iter": dict(use_pallas_fp=True, oldest_k_method="iter"),
@@ -109,17 +109,28 @@ try:
     )
 except ImportError:
     pass
-for name, kw in variants.items():
+for tick_n in (16384, 32768):
+    st = init_state(tick_n, seed=0, track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
+    inp = idle_inputs(tick_n, ticks=8)
+    suffix = "" if tick_n == 16384 else f"_n{tick_n}"
+    for name, kw in variants.items():
+        try:
+            cfg = SwimConfig(**kw)
+            @jax.jit
+            def run(s, i, cfg=cfg):
+                o, _ = simulate(s, i, cfg, faulty=False)
+                return o.timer.sum() + o.tick
+            sec = fetch_timeit(run, st, inp, reps=2)
+            out[f"tick_{name}{suffix}_ms"] = sec / 8 * 1e3
+        except Exception as e:
+            out[f"tick_{name}{suffix}_error"] = repr(e)[:300]
     try:
-        cfg = SwimConfig(**kw)
-        @jax.jit
-        def run(s, i, cfg=cfg):
-            o, _ = simulate(s, i, cfg, faulty=False)
-            return o.timer.sum() + o.tick
-        sec = fetch_timeit(run, st, inp, reps=2)
-        out[f"tick_{name}_ms"] = sec / 8 * 1e3
-    except Exception as e:
-        out[f"tick_{name}_error"] = repr(e)[:300]
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out[f"peak_bytes_in_use{suffix}"] = stats.get("peak_bytes_in_use")
+    except Exception:
+        pass
+    del st, inp
 
 # What does the axon device report for memory accounting? (bench's
 # peak_hbm_mib came back null; record the raw keys so it can be fixed.)
@@ -167,6 +178,20 @@ def _run_group(cmd: list[str], timeout_s: int, discard_output: bool = False):
         return None, ""
 
 
+def find_metric_line(out: str) -> str | None:
+    """Last stdout line that is the bench's JSON result (stderr is merged
+    into the capture, so detect by the "metric" key, not position)."""
+    for ln in reversed(out.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                if "metric" in json.loads(ln):
+                    return ln
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def probe() -> bool:
     # The probe-under-wedge pattern lives in bench.py (_probe_once: DEVNULL
     # pipes, own session, group kill); reuse it so the two stay in sync.
@@ -198,22 +223,22 @@ def main() -> None:
                 time.sleep(POLL_INTERVAL_S)
                 continue
             # Microbench landed; now the full bench in the same window.
-            # stderr is merged into the capture, so find the result by
-            # parsing rather than position: the last line that is JSON with
-            # the bench's "metric" key.
             rc, out = _run_group([sys.executable, "bench.py"], MEASURE_TIMEOUT_S)
-            result = None
-            for ln in reversed(out.splitlines()):
-                ln = ln.strip()
-                if ln.startswith("{"):
-                    try:
-                        if "metric" in json.loads(ln):
-                            result = ln
-                            break
-                    except json.JSONDecodeError:
-                        continue
+            result = find_metric_line(out)
             log({"ts": time.time(), "kind": "bench", "rc": rc, "json": result,
                  **({} if result else {"tail": out[-1500:]})})
+            # Single-chip ceiling attempts (VERDICT r4 item 2): N=65,536 lean
+            # is expected to OOM on one 16 GiB chip (MEMORY_PLAN.md says
+            # sharded-only) but the attempt + recorded error is the evidence;
+            # N=32,768 headline already ran inside the full bench above.
+            rc, out = _run_group(
+                [sys.executable, "bench.py", "--n", "65536",
+                 "--no-gossip", "--no-scenarios", "--no-probe"],
+                MEASURE_TIMEOUT_S,
+            )
+            result = find_metric_line(out)
+            log({"ts": time.time(), "kind": "bench_n65536", "rc": rc,
+                 "json": result, **({} if result else {"tail": out[-1200:]})})
             # Keep polling at a relaxed cadence: later windows yield fresh
             # captures (the log keeps every one; readers take the newest).
             time.sleep(3600)

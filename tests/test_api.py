@@ -22,6 +22,7 @@ def _demo_mesh():
     return net, nodes
 
 
+@pytest.mark.slow
 def test_2x2_demo_converges_with_matching_fingerprints():
     net, nodes = _demo_mesh()
     ticks = net.tick_until_converged(max_ticks=16)
@@ -52,6 +53,7 @@ def test_lifecycle_guards():
         Kaboodle(full, b"overflow")  # network full
 
 
+@pytest.mark.slow
 def test_departure_detection_after_stop():
     """Kill one pane; survivors detect via ping-timeout -> indirect-ping ->
     removal (kaboodle.rs:558-653) and the departure stream fires."""
@@ -77,6 +79,7 @@ def test_departure_detection_after_stop():
     assert 3 in nodes[3].peers()
 
 
+@pytest.mark.slow
 def test_discovery_stream_and_next_peer():
     net = SimNetwork(capacity=3)
     a = Kaboodle(net, b"a")
@@ -91,6 +94,7 @@ def test_discovery_stream_and_next_peer():
     assert got is not None and got[0] == 1
 
 
+@pytest.mark.slow
 def test_restart_rejoins_with_reset():
     net, nodes = _demo_mesh()
     net.tick_until_converged(max_ticks=16)
@@ -122,6 +126,7 @@ def test_set_identity_reannounces_and_changes_fingerprint():
     assert nodes[1].peers()[0] == b"renamed"
 
 
+@pytest.mark.slow
 def test_manual_ping_bootstrap():
     """With broadcasts suppressed by full drop, ping_addrs is the only way to
     meet — the reference's manual bootstrap path (lib.rs:268-297)."""
@@ -167,6 +172,7 @@ def test_start_stop_before_tick_cancel_cleanly():
     assert bool(net.state.alive[0])
 
 
+@pytest.mark.slow
 def test_convergence_timeout_raises():
     from kaboodle_tpu.errors import ConvergenceTimeout
 
@@ -180,6 +186,7 @@ def test_convergence_timeout_raises():
         net.tick_until_converged(max_ticks=4)
 
 
+@pytest.mark.slow
 def test_peer_states_surfaces_latency_ewma():
     """After a few ticks of traffic, the per-peer latency EWMA is a real
     number (kaboodle.rs:789-817 surfaced via lib.rs:348-354). Self has no

@@ -22,6 +22,7 @@ def _states_equal(a, b):
             assert jnp.array_equal(va, vb, equal_nan=True), f.name
 
 
+@pytest.mark.slow
 def test_resume_is_bit_exact(tmp_path):
     n, cfg = 24, SwimConfig()
     st = init_state(n, seed=13)
@@ -48,6 +49,7 @@ def test_load_onto_mesh(tmp_path):
     _states_equal(st, sharded)
 
 
+@pytest.mark.slow
 def test_lean_state_roundtrip(tmp_path):
     """The memory-lean state (track_latency=False, instant_identity=True) —
     what the 65k-peer configs run — must roundtrip with its optional fields
@@ -81,6 +83,7 @@ def test_lean_load_onto_mesh(tmp_path):
     _states_equal(st, sharded)
 
 
+@pytest.mark.slow
 def test_orbax_async_roundtrip(tmp_path):
     """save_async + load_orbax: background write, bit-exact resume, lean
     fields and narrow dtypes preserved."""

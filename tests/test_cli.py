@@ -42,6 +42,7 @@ def test_format_peer_table():
     assert "(me)" in out and "WaitingForPing" in out and "12.5ms" in out
 
 
+@pytest.mark.slow
 def test_sim_mode(capsys):
     rc = main(["--sim", "64", "--ticks", "8"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -54,6 +55,7 @@ def test_sim_scenario_mode(capsys):
     assert rc == 0 and out["n_peers"] == 4
 
 
+@pytest.mark.slow
 def test_two_instance_live_demo():
     """The run2x2 demo shape as a subprocess test: two CLI instances find each
     other and report 2 peers with matching fingerprints."""

@@ -12,6 +12,7 @@ from kaboodle_tpu.events import (
 )
 from kaboodle_tpu.oracle.fingerprint import mix_fingerprint
 from kaboodle_tpu.sim import init_state, simulate, idle_inputs
+import pytest
 
 
 IDS = np.arange(1, 9, dtype=np.uint32)
@@ -79,6 +80,7 @@ def test_membership_diff_matches_tap():
     assert np.flatnonzero(removed[0]).tolist() == [1]
 
 
+@pytest.mark.slow
 def test_tap_over_simulated_run():
     """Feeding per-tick rows of a real run: observer 0 discovers the whole
     mesh; the last announced fingerprint matches the final converged state."""

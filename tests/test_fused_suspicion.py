@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_suspicion import fused_suspicion
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_PING
+import pytest
 
 
 def _reference(state, timer, alive, thr):
@@ -47,6 +48,7 @@ def test_fused_matches_reference():
             np.testing.assert_array_equal(np.asarray(fj), rj)
 
 
+@pytest.mark.slow
 def test_kernel_trajectory_with_fused_suspicion():
     """Whole-tick parity under drops heavy enough to force escalations: the
     fused phase-A stats must reproduce the default kernel trajectory

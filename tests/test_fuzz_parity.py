@@ -20,6 +20,10 @@ from kaboodle_tpu.oracle.lockstep import LockstepMesh
 from kaboodle_tpu.sim.state import init_state
 from tests.test_kernel_parity import _inputs, _run_parity
 
+# Heavy end-to-end lanes (subprocess cluster / randomized fuzzing):
+# excluded from `make test-quick`, always run in CI.
+pytestmark = pytest.mark.slow
+
 TICKS = 10
 
 

@@ -18,6 +18,7 @@ from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.hashing import membership_fingerprint
 from kaboodle_tpu.sim import Scenario, init_state, simulate
 from kaboodle_tpu.spec import KNOWN
+import pytest
 
 # derandomize: the example stream is fixed per test body, so CI is
 # reproducible — a failure at HEAD is a failure on every run of HEAD, never a
@@ -56,6 +57,7 @@ def scenarios(draw):
 
 @hypothesis.given(scenarios())
 @hypothesis.settings(**SETTINGS)
+@pytest.mark.slow
 def test_core_invariants(sc):
     cfg = SwimConfig()
     st0 = init_state(sc.n, seed=sc.seed, alive=jnp.asarray(sc.initial_alive()))
@@ -98,6 +100,7 @@ def test_core_invariants(sc):
 
 @hypothesis.given(scenarios())
 @hypothesis.settings(**SETTINGS)
+@pytest.mark.slow
 def test_determinism(sc):
     """Same seed + same schedule => bit-identical trajectory (the simulator's
     race-detection substitute, SURVEY.md §5)."""
@@ -114,6 +117,7 @@ def test_determinism(sc):
 
 @hypothesis.given(st.sampled_from([8, 16, 32]), st.integers(0, 2**31 - 1))
 @hypothesis.settings(**SETTINGS)
+@pytest.mark.slow
 def test_faultfree_boot_converges(n, seed):
     """I6: with no faults, a fresh mesh always reaches full membership and
     agreement quickly (every peer broadcasts Join at tick 0; replies bootstrap

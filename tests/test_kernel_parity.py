@@ -269,6 +269,7 @@ def test_epidemic_boot_parity():
     assert mesh.converged(), "epidemic boot should converge within 10 ticks at N=12"
 
 
+@pytest.mark.slow
 def test_epidemic_boot_scales_logarithmically():
     """Convergence ticks for the epidemic boot grow far slower than N —
     the whole point of the extension (random mode, ring seed)."""
@@ -286,6 +287,7 @@ def test_epidemic_boot_scales_logarithmically():
     assert ticks_at[256] < ticks_at[64] * 3, ticks_at
 
 
+@pytest.mark.slow
 def test_share_cap_parity():
     """D5: the join-response share cap (kernel.py share_base branch; the
     reference's 10 KiB trim, kaboodle.rs:373-383). An isolated peer joins

@@ -21,6 +21,11 @@ import jax
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.sim.runner import simulate
 from kaboodle_tpu.sim.state import idle_inputs, init_state
+import pytest
+
+# Heavy end-to-end lanes (subprocess cluster / randomized fuzzing):
+# excluded from `make test-quick`, always run in CI.
+pytestmark = pytest.mark.slow
 
 _WORKER = Path(__file__).resolve().parent.parent / "scripts" / "multihost_worker.py"
 _N, _TICKS = 64, 8

@@ -5,6 +5,7 @@ import numpy as np
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.profiling import log_run, tick_stats, trace
 from kaboodle_tpu.sim import idle_inputs, init_state, simulate
+import pytest
 
 
 def _run(n=16, ticks=6):
@@ -12,6 +13,7 @@ def _run(n=16, ticks=6):
     return simulate(init_state(n, seed=1), idle_inputs(n, ticks=ticks), cfg)
 
 
+@pytest.mark.slow
 def test_tick_stats_table_matches_metrics():
     _, m = _run()
     table = tick_stats(m)
@@ -27,6 +29,7 @@ def test_tick_stats_table_matches_metrics():
     assert (table["fingerprint_min"] == table["fingerprint_max"])[-1]
 
 
+@pytest.mark.slow
 def test_log_run_emits_one_line_per_tick():
     _, m = _run()
     lines = []
@@ -36,6 +39,7 @@ def test_log_run_emits_one_line_per_tick():
     assert "CONVERGED" in lines[-1]
 
 
+@pytest.mark.slow
 def test_trace_captures_profile(tmp_path):
     with trace(str(tmp_path)):
         _run(n=8, ticks=2)

@@ -4,6 +4,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import pytest
+
 from kaboodle_tpu.ops import (
     bernoulli_matrix,
     broadcast_reply_prob,
@@ -98,6 +100,7 @@ def test_bernoulli_matrix_rate():
     assert det.all()
 
 
+@pytest.mark.slow
 def test_stable_k_smallest_iter_equals_topk():
     """The iterative oldest-k (SwimConfig.oldest_k_method='iter') must agree
     with sort-based top_k exactly: same candidate indices, same validity —

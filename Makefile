@@ -54,12 +54,15 @@ scale-proof:
 	$(PYTHON) scripts/sharded_scale_proof.py --n 8192 --devices 8 --ticks 8 --boot epidemic
 
 # North-star scale (BASELINE configs 4-5): N=65,536 lean+int16 sharded,
-# broadcast boot to asserted convergence + steady-state faulty ticks with
-# peak RSS against MEMORY_PLAN.md. Drop stays off: the [N, N] uniform draw
-# alone is 16 GiB at this N. ~an hour on a single-core host.
+# broadcast boot to asserted convergence + 2 steady-state faulty ticks
+# (single execution, compile included) with peak RSS against
+# MEMORY_PLAN.md. Drop stays off: the [N, N] uniform draw alone is 16 GiB
+# at this N. ~0.5-1 h on a single-core host (~13 min per faulty tick, plus
+# boot and compile); needs XLA's CPU collective rendezvous timeouts raised
+# when the emulating host is slow — see SCALE_PROOF.md.
 scale-proof-65k:
-	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 4 \
-	  --boot broadcast --boot-max-ticks 8 --drop-rate 0
+	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 2 \
+	  --boot broadcast --boot-max-ticks 8 --drop-rate 0 --faulty-runs 1
 
 # Two-machine real-network demo (reference justfile:57-78 analogue); see
 # scripts/cross_host.sh for the interface-selection rules.

@@ -1,0 +1,101 @@
+"""Churn-recovery curve: agreement fraction per tick after the config-3
+churn window closes (VERDICT r4 item 3's PERF.md curve).
+
+Runs the BASELINE config-3 schedule (5%/tick join+leave churn over the
+first half) at ``--n``, then keeps scanning calm ticks in chunks, recording
+``TickMetrics.agree_fraction`` / ``converged`` per tick until agreement or
+the ~2.5N budget. Prints one JSON line with a downsampled curve.
+
+The shape of the curve is the suspicion/removal pipeline in action
+(kaboodle.rs:558-653): a long flat head while every survivor's oldest-5
+rotation works through its backlog of equal-age entries, then a rapid climb
+as removals complete (the reference's ~2N completeness bound, SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--ticks", type=int, default=64, help="churn-window run length")
+    p.add_argument("--chunk", type=int, default=64)
+    p.add_argument("--points", type=int, default=64,
+                   help="max curve points in the output (downsampled)")
+    args = p.parse_args()
+
+    from axon_guard import strip_axon_plugin
+
+    strip_axon_plugin()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from bench import _recovery_budget, _scenario_state_and_inputs
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.runner import simulate
+    from kaboodle_tpu.sim.state import idle_inputs
+
+    n, ticks = args.n, args.ticks
+    cfg = SwimConfig()
+    budget = _recovery_budget(n)
+    st, inp = _scenario_state_and_inputs(3, n, ticks, calm_budget=budget)
+
+    # jit once so the calm-chunk loop reuses one compiled scan instead of
+    # re-tracing per chunk (bench._bench_churn_recovery's pattern).
+    run_churn = jax.jit(lambda s, i: simulate(s, i, cfg, faulty=True))
+    run_calm = jax.jit(lambda s, i: simulate(s, i, cfg, faulty=False))
+
+    t0 = time.perf_counter()
+    st, m = run_churn(st, inp)
+    agree = list(np.asarray(m.agree_fraction))
+    conv = list(np.asarray(m.converged))
+    memb = list(np.asarray(m.mean_membership))
+
+    calm = idle_inputs(n, ticks=args.chunk)
+    while not conv[-1] and len(conv) < ticks + budget:
+        st, m = run_calm(st, calm)
+        agree.extend(np.asarray(m.agree_fraction))
+        conv.extend(np.asarray(m.converged))
+        memb.extend(np.asarray(m.mean_membership))
+    wall = time.perf_counter() - t0
+
+    stop = ticks // 2  # churn window closes here (baseline_scenario config 3)
+    first_true = next((i for i, c in enumerate(conv) if i >= stop and c), None)
+    # Downsample the curve for the report; keep the exact endpoints.
+    idxs = sorted({0, stop, len(agree) - 1}
+                  | set(range(0, len(agree), max(1, len(agree) // args.points))))
+    # mean_membership is the readable recovery signal: agreement-with-min is
+    # a step function (one peer holds the min until the final removal wave),
+    # while mean row membership drains ~linearly as the pipeline completes.
+    curve = [[int(i), round(float(agree[i]), 4), round(float(memb[i]), 1)]
+             for i in idxs]
+    print(json.dumps({
+        "n": n,
+        "churn_ticks": stop,
+        "churn_rate": 0.05,
+        "survivors": int(np.asarray(st.alive).sum()),
+        "reconverged": bool(conv[-1]),
+        "reconverge_tick_abs": first_true,
+        "reconverge_ticks_after_churn": (
+            (first_true - stop) if first_true is not None else None),
+        "completeness_bound_2n": 2 * n,
+        "curve_fields": ["tick", "agree_fraction", "mean_membership"],
+        "curve": curve,
+        "wall_s": round(wall, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,9 +58,12 @@ scale-proof:
 # (single execution, compile included) with peak RSS against
 # MEMORY_PLAN.md. Drop stays off: the [N, N] uniform draw alone is 16 GiB
 # at this N. ~0.5-1 h on a single-core host (~13 min per faulty tick, plus
-# boot and compile); needs XLA's CPU collective rendezvous timeouts raised
-# when the emulating host is slow — see SCALE_PROOF.md.
+# boot and compile). XLA's CPU in-process collectives abort if a rendezvous
+# waits > 40 s — at this size each single-core shard computes for minutes
+# between collectives, so the target raises both timeout flags itself.
 scale-proof-65k:
+	XLA_FLAGS="--xla_cpu_collective_call_terminate_timeout_seconds=21600 \
+	  --xla_cpu_collective_timeout_seconds=21600 $$XLA_FLAGS" \
 	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 2 \
 	  --boot broadcast --boot-max-ticks 8 --drop-rate 0 --faulty-runs 1
 

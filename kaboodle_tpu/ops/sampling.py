@@ -33,7 +33,9 @@ def _stable_k_smallest_topk(scores: jax.Array, k: int, tmax) -> tuple[jax.Array,
     (> INT32_MIN, trivially true for tick stamps)."""
     wide = scores.astype(jnp.int32) if scores.dtype == jnp.int16 else scores
     neg_vals, idx = jax.lax.top_k(-wide, k)  # [N, k]
-    return idx.astype(jnp.int32), neg_vals != -wide.dtype.type(tmax)
+    # Sentinel test stays in jnp: converting tmax through a numpy scalar
+    # (wide.dtype.type(tmax)) breaks under jit when tmax traces (int16 path).
+    return idx.astype(jnp.int32), neg_vals != -jnp.asarray(tmax, wide.dtype)
 
 
 def _stable_k_smallest_iter(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:

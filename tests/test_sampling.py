@@ -146,3 +146,24 @@ def test_choose_one_of_oldest_k_methods_identical():
             a = choose_one_of_oldest_k(timer, eligible, 5, key, det, method="topk")
             b = choose_one_of_oldest_k(timer, eligible, 5, key, det, method="iter")
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_choose_one_of_oldest_k_traces_under_jit():
+    """Regression: the topk sentinel test once converted tmax through a numpy
+    scalar (``wide.dtype.type(tmax)``), which crashes with
+    TracerArrayConversionError the first time the op is traced with int16
+    timers — eager tests can't see it. Trace both methods x both dtypes."""
+    import functools
+
+    rng = np.random.default_rng(11)
+    n = 24
+    eligible = jnp.asarray(rng.random((n, n)) < 0.5)
+    key = jax.random.key(9)
+    for dtype in (np.int16, np.int32):
+        timer = jnp.asarray(rng.integers(0, 50, size=(n, n), dtype=dtype))
+        picks = {}
+        for method in ("topk", "iter"):
+            f = jax.jit(functools.partial(
+                choose_one_of_oldest_k, k=5, deterministic=False, method=method))
+            picks[method] = np.asarray(f(timer=timer, eligible=eligible, key=key))
+        np.testing.assert_array_equal(picks["topk"], picks["iter"])

@@ -22,7 +22,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 LOG = "TPU_WATCH.log"
 PROBE_TIMEOUT_S = 150
-MEASURE_TIMEOUT_S = 2400
+# 10 whole-tick jit compiles (5 variants x 2 sizes) through the tunnel's
+# remote_compile can exceed 40 min; partial WATCHPART banking means a long
+# budget risks nothing even if the window closes mid-measure.
+MEASURE_TIMEOUT_S = 5400
 POLL_INTERVAL_S = 240
 
 MEASURE = r"""
@@ -30,6 +33,16 @@ import json, time, functools
 import numpy as np, jax, jax.numpy as jnp
 
 out = {"ts": time.time(), "kind": "measure"}
+
+# Dict that re-prints the whole capture (flushed) on every write, so a
+# mid-measure wedge still banks everything measured before the kill: the
+# watcher logs the last WATCHPART line when no final WATCHJSON landed.
+class _Partial(dict):
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        print("WATCHPART " + json.dumps(dict(self)), flush=True)
+
+out = _Partial(out)
 
 def fetch_timeit(f, *a, reps=3):
     # axon block_until_ready does not synchronize; time via scalar fetch
@@ -46,6 +59,60 @@ def fetch_timeit(f, *a, reps=3):
 
 n = 16384
 rng = np.random.default_rng(0)
+
+# ---- 1. Whole-tick A/B FIRST, most valuable variant first ------------------
+# The wedge pattern (TPU_BENCH_NOTES.md) is that a long compile can close the
+# window mid-measure; every metric already banked is kept via WATCHPART, so
+# order strictly by value: the post-rewrite fused_all tick at N=16,384 is THE
+# round-4 headline (VERDICT item 1), then the ablation variants, then the
+# component microbench, then the N=32,768 ceiling.
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.runner import simulate
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+variants = {}
+try:
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
+    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion  # noqa: F401
+    variants["fused_all"] = dict(
+        use_pallas_fp=True, use_pallas_oldest_k=True, use_pallas_suspicion=True
+    )
+except ImportError:
+    pass
+try:
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
+    variants["fusedk"] = dict(use_pallas_fp=True, use_pallas_oldest_k=True)
+except ImportError:
+    pass
+variants["iter"] = dict(use_pallas_fp=True, oldest_k_method="iter")
+variants["topk"] = dict(use_pallas_fp=True, oldest_k_method="topk")
+variants["nopallas"] = dict()
+
+def tick_ab(tick_n):
+    st = init_state(tick_n, seed=0, track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
+    inp = idle_inputs(tick_n, ticks=8)
+    suffix = "" if tick_n == 16384 else f"_n{tick_n}"
+    for name, kw in variants.items():
+        try:
+            cfg = SwimConfig(**kw)
+            @jax.jit
+            def run(s, i, cfg=cfg):
+                o, _ = simulate(s, i, cfg, faulty=False)
+                return o.timer.sum() + o.tick
+            sec = fetch_timeit(run, st, inp, reps=2)
+            out[f"tick_{name}{suffix}_ms"] = sec / 8 * 1e3
+        except Exception as e:
+            out[f"tick_{name}{suffix}_error"] = repr(e)[:300]
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out[f"peak_bytes_in_use{suffix}"] = stats.get("peak_bytes_in_use")
+    except Exception:
+        pass
+
+tick_ab(16384)
+
+# ---- 2. Component microbench at N=16,384 -----------------------------------
 S = jnp.asarray(rng.integers(0, 3, (n, n)), jnp.int8)
 T = jnp.asarray(rng.integers(0, 100, (n, n)), jnp.int16)
 rh = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
@@ -86,54 +153,10 @@ tgt = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
 val = jnp.ones((n,), bool)
 out["scatter_mark_ms"] = fetch_timeit(scatter_mark, S, tgt, val) * 1e3
 out["onehot_mark_ms"] = fetch_timeit(onehot_mark, S, tgt, val) * 1e3
+del S, T, rh, elig, tgt, val
 
-# Whole-tick A/B, lean+int16, fault-free (the bench configuration), at the
-# round-3 capture size AND the single-chip ceiling (VERDICT r4 item 1:
-# the fused-kernel story needs measured ms/tick at 16,384 and 32,768
-# against the 10-20 ms HBM roofline floor, PERF.md).
-from kaboodle_tpu.config import SwimConfig
-from kaboodle_tpu.sim.runner import simulate
-from kaboodle_tpu.sim.state import idle_inputs, init_state
-
-variants = {
-    "topk": dict(use_pallas_fp=True, oldest_k_method="topk"),
-    "iter": dict(use_pallas_fp=True, oldest_k_method="iter"),
-}
-variants["nopallas"] = dict()
-try:
-    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
-    variants["fusedk"] = dict(use_pallas_fp=True, use_pallas_oldest_k=True)
-except ImportError:
-    pass
-try:
-    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion  # noqa: F401
-    variants["fused_all"] = dict(
-        use_pallas_fp=True, use_pallas_oldest_k=True, use_pallas_suspicion=True
-    )
-except ImportError:
-    pass
-for tick_n in (16384, 32768):
-    st = init_state(tick_n, seed=0, track_latency=False, instant_identity=True,
-                    timer_dtype=jnp.int16)
-    inp = idle_inputs(tick_n, ticks=8)
-    suffix = "" if tick_n == 16384 else f"_n{tick_n}"
-    for name, kw in variants.items():
-        try:
-            cfg = SwimConfig(**kw)
-            @jax.jit
-            def run(s, i, cfg=cfg):
-                o, _ = simulate(s, i, cfg, faulty=False)
-                return o.timer.sum() + o.tick
-            sec = fetch_timeit(run, st, inp, reps=2)
-            out[f"tick_{name}{suffix}_ms"] = sec / 8 * 1e3
-        except Exception as e:
-            out[f"tick_{name}{suffix}_error"] = repr(e)[:300]
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        out[f"peak_bytes_in_use{suffix}"] = stats.get("peak_bytes_in_use")
-    except Exception:
-        pass
-    del st, inp
+# ---- 3. The single-chip ceiling size last ----------------------------------
+tick_ab(32768)
 
 # What does the axon device report for memory accounting? (bench's
 # peak_hbm_mib came back null; record the raw keys so it can be fixed.)
@@ -148,37 +171,46 @@ print("WATCHJSON " + json.dumps(out))
 """
 
 
-def _run_group(cmd: list[str], timeout_s: int, discard_output: bool = False):
+def _run_group(cmd: list[str], timeout_s: int):
     """Run cmd in its own process group with a hard timeout.
 
     A wedged tunnel helper can inherit our pipes and keep them open past the
     direct child's death, hanging subprocess.run's drain (the failure mode
-    bench.py's _probe_once documents); kill the whole group on timeout so
-    the pipes actually close. Returns (rc, stdout) — rc None on timeout.
+    bench.py's _probe_once documents); route output through a temp file so a
+    group kill on timeout still yields everything written so far (the
+    WATCHPART partial-capture contract). Returns (rc, stdout) — rc None on
+    timeout.
     """
     import os
     import signal
+    import tempfile
 
-    if discard_output:
-        stdout, stderr = subprocess.DEVNULL, subprocess.DEVNULL
-    else:
-        stdout, stderr = subprocess.PIPE, subprocess.STDOUT
+    sink = tempfile.TemporaryFile(mode="w+", prefix="tpu_watch_")
     proc = subprocess.Popen(
-        cmd, stdout=stdout, stderr=stderr, text=True, start_new_session=True
+        cmd, stdout=sink, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,
     )
+
+    def _read_sink() -> str:
+        sink.flush()
+        sink.seek(0)
+        out = sink.read()
+        sink.close()
+        return out
+
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
-        return proc.returncode, out or ""
+        proc.wait(timeout=timeout_s)
+        return proc.returncode, _read_sink()
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             pass
         try:
-            proc.communicate(timeout=10)
+            proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             pass
-        return None, ""
+        return None, _read_sink()
 
 
 def find_metric_line(out: str) -> str | None:
@@ -216,13 +248,34 @@ def main() -> None:
         log({"ts": time.time(), "kind": "probe", "attempt": attempt, "alive": alive})
         if alive:
             rc, out = _run_group([sys.executable, "-c", MEASURE], MEASURE_TIMEOUT_S)
-            for line in out.splitlines():
-                if line.startswith("WATCHJSON "):
-                    log(json.loads(line[len("WATCHJSON "):]))
+            # A SIGKILL mid-write can truncate the last WATCHPART line and
+            # stderr shares the fd, so parse defensively: walk candidates
+            # newest-first and keep the first intact one.
+            banked = None
+            for line in reversed(out.splitlines()):
+                for tag, kind in (("WATCHJSON ", None), ("WATCHPART ", "measure_partial")):
+                    if line.startswith(tag):
+                        try:
+                            obj = json.loads(line[len(tag):])
+                        except json.JSONDecodeError:
+                            continue
+                        if kind:
+                            obj = {**obj, "kind": kind, "rc": rc}
+                        banked = obj
+                        break
+                if banked:
                     break
+            if banked:
+                log(banked)
             else:
                 log({"ts": time.time(), "kind": "measure_failed", "rc": rc,
                      "tail": out[-2000:]})
+                time.sleep(POLL_INTERVAL_S)
+                continue
+            if rc is None:
+                # The measure itself was killed at the timeout — the window
+                # likely wedged. Partials are banked; don't burn hours running
+                # the full bench against a dead tunnel. Back to polling.
                 time.sleep(POLL_INTERVAL_S)
                 continue
             # Microbench landed; now the full bench in the same window.

@@ -65,7 +65,8 @@ scale-proof-65k:
 	XLA_FLAGS="--xla_cpu_collective_call_terminate_timeout_seconds=21600 \
 	  --xla_cpu_collective_timeout_seconds=21600 $$XLA_FLAGS" \
 	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 2 \
-	  --boot broadcast --boot-max-ticks 8 --drop-rate 0 --faulty-runs 1
+	  --boot broadcast --boot-max-ticks 8 --drop-rate 0 --faulty-runs 1 \
+	  --stepwise
 
 # Two-machine real-network demo (reference justfile:57-78 analogue); see
 # scripts/cross_host.sh for the interface-selection rules.

@@ -50,6 +50,13 @@ def main() -> None:
                         "1 = a single execution reported as run_s with "
                         "compile included — for sizes where one faulty tick "
                         "costs tens of minutes on the emulating host")
+    p.add_argument("--stepwise", action="store_true",
+                   help="tick-at-a-time host loop with donated carries instead "
+                        "of while_loop/scan: every tick's transients are freed "
+                        "between steps and the carry is donated, cutting peak "
+                        "RSS on the emulating host (the N=65,536 while_loop "
+                        "boot OOM-kills a 125 GiB host; implies the "
+                        "single-run compile-included timing)")
     args = p.parse_args()
 
     # Pin the virtual-CPU platform before JAX can initialize any backend
@@ -67,13 +74,14 @@ def main() -> None:
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.parallel import (
         make_mesh,
+        make_sharded_tick,
         run_until_converged_sharded,
         shard_inputs,
         shard_state,
         simulate_sharded,
     )
     from kaboodle_tpu.sim.scenario import all_fault_paths_scenario
-    from kaboodle_tpu.sim.state import init_state
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
 
     from bench import LEAN_STATE_MIN_N
 
@@ -111,10 +119,23 @@ def main() -> None:
             mesh,
         )
         t0 = time.perf_counter()
-        booted, boot_ticks, conv = run_until_converged_sharded(
-            st0, boot_cfg, mesh, max_ticks=args.boot_max_ticks
-        )
-        boot_ticks_v, conv_v = int(boot_ticks), bool(conv)
+        if args.stepwise:
+            boot_tick = jax.jit(
+                make_sharded_tick(boot_cfg, mesh, faulty=False), donate_argnums=0
+            )
+            idle = shard_inputs(idle_inputs(n), mesh)
+            booted, conv_v, boot_ticks_v = st0, False, 0
+            for _ in range(args.boot_max_ticks):
+                booted, m = boot_tick(booted, idle)
+                boot_ticks_v += 1
+                if bool(m.converged):  # host fetch syncs the tick
+                    conv_v = True
+                    break
+        else:
+            booted, boot_ticks, conv = run_until_converged_sharded(
+                st0, boot_cfg, mesh, max_ticks=args.boot_max_ticks
+            )
+            boot_ticks_v, conv_v = int(boot_ticks), bool(conv)
         boot_wall = time.perf_counter() - t0
         assert conv_v, (
             f"{args.boot} boot failed to converge within "
@@ -137,46 +158,54 @@ def main() -> None:
 
     # ---- phase 2: every-fault-path steady-state scan -----------------------
     cfg = SwimConfig()
-    inp = shard_inputs(
-        all_fault_paths_scenario(n, ticks=ticks, drop_rate=args.drop_rate).build(),
-        mesh,
-        stacked=True,
-    )
+    sched = all_fault_paths_scenario(n, ticks=ticks, drop_rate=args.drop_rate).build()
 
-    def run(s, i):
-        out, _ = simulate_sharded(s, i, cfg, mesh, faulty=True)
-        return out
+    if args.stepwise:
+        ftick = jax.jit(make_sharded_tick(cfg, mesh, faulty=True), donate_argnums=0)
+        t0 = time.perf_counter()
+        final = start
+        for t in range(ticks):
+            inp_t = shard_inputs(jax.tree.map(lambda x: x[t], sched), mesh)
+            final, _ = ftick(final, inp_t)
+        final.state.block_until_ready()
+        first_wall = run_wall = time.perf_counter() - t0  # includes compile
+    else:
+        inp = shard_inputs(sched, mesh, stacked=True)
 
-    t0 = time.perf_counter()
-    final = run(start, inp)
-    final.state.block_until_ready()
-    first_wall = time.perf_counter() - t0  # includes compile
+        def run(s, i):
+            out, _ = simulate_sharded(s, i, cfg, mesh, faulty=True)
+            return out
 
-    if args.faulty_runs == 2:
         t0 = time.perf_counter()
         final = run(start, inp)
         final.state.block_until_ready()
-        run_wall = time.perf_counter() - t0
-    else:
-        run_wall = first_wall  # single execution: compile not separable
+        first_wall = time.perf_counter() - t0  # includes compile
+
+        if args.faulty_runs == 2:
+            t0 = time.perf_counter()
+            final = run(start, inp)
+            final.state.block_until_ready()
+            run_wall = time.perf_counter() - t0
+        else:
+            run_wall = first_wall  # single execution: compile not separable
 
     assert final.state.shape == (n, n)
     assert len(final.state.sharding.device_set) == args.devices, (
         "final state not sharded across the full mesh"
     )
 
+    timed = args.faulty_runs == 2 and not args.stepwise
     peak_rss_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     line.update({
         "ticks": ticks,
         "drop_rate": args.drop_rate,
-        "compile_s": (round(first_wall - run_wall, 3)
-                      if args.faulty_runs == 2 else None),
+        "compile_s": round(first_wall - run_wall, 3) if timed else None,
         "run_s": round(run_wall, 3),
-        "run_includes_compile": args.faulty_runs == 1,
+        "run_includes_compile": not timed,
+        "stepwise": args.stepwise,
         # Throughput is only meaningful when compile is excluded; null it in
         # single-run mode so rows stay comparable across SCALE_PROOF.md.
-        "peers_ticks_per_sec": (round(n * ticks / run_wall, 1)
-                                if args.faulty_runs == 2 else None),
+        "peers_ticks_per_sec": round(n * ticks / run_wall, 1) if timed else None,
         "peak_rss_mib": round(peak_rss_mib, 1),
         "faulty": True,
     })

@@ -18,9 +18,13 @@ from pathlib import Path
 
 # Run as `python scripts/tpu_watch.py`: sys.path[0] is scripts/, so the repo
 # root (for `from bench import _probe_once`) must be added explicitly.
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
 
-LOG = "TPU_WATCH.log"
+# All artifacts anchor to the repo root, not the cwd: bench.py's wedged-window
+# fallback globs BENCH_r*_local.json next to itself, so a watcher started from
+# elsewhere must still bank where bench.py reads.
+LOG = str(REPO_ROOT / "TPU_WATCH.log")
 PROBE_TIMEOUT_S = 150
 # 10 whole-tick jit compiles (5 variants x 2 sizes) through the tunnel's
 # remote_compile can exceed 40 min; partial WATCHPART banking means a long
@@ -272,10 +276,11 @@ def main() -> None:
                      "tail": out[-2000:]})
                 time.sleep(POLL_INTERVAL_S)
                 continue
-            if rc is None:
-                # The measure itself was killed at the timeout — the window
-                # likely wedged. Partials are banked; don't burn hours running
-                # the full bench against a dead tunnel. Back to polling.
+            if rc != 0:
+                # Timeout kill (rc None) or crash (the round-4 wedges surfaced
+                # as raised exceptions, not hangs): the window just proved
+                # unhealthy. Partials are banked; don't burn hours running the
+                # full bench against a dead tunnel. Back to polling.
                 time.sleep(POLL_INTERVAL_S)
                 continue
             # Microbench landed; now the full bench in the same window.
@@ -283,6 +288,17 @@ def main() -> None:
             result = find_metric_line(out)
             log({"ts": time.time(), "kind": "bench", "rc": rc, "json": result,
                  **({} if result else {"tail": out[-1500:]})})
+            if result:
+                # A real-TPU bench line is the round's banked local capture
+                # (what bench.py attaches as banked_tpu_capture when a later
+                # run lands in a wedged window). Bank it unattended.
+                try:
+                    data = json.loads(result)
+                    if str(data.get("backend", "")).startswith("tpu"):
+                        with open(REPO_ROOT / "BENCH_r04_local.json", "w") as f:
+                            f.write(result + "\n")
+                except (ValueError, OSError):
+                    pass
             # Single-chip ceiling attempts (VERDICT r4 item 2): N=65,536 lean
             # is expected to OOM on one 16 GiB chip (MEMORY_PLAN.md says
             # sharded-only) but the attempt + recorded error is the evidence;

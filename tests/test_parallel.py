@@ -172,3 +172,27 @@ def test_sharded_epidemic_boot_converges(mesh8):
     assert bool(conv), "epidemic boot did not converge under GSPMD"
     assert 1 < int(ticks) < 256  # genuinely epidemic, not broadcast-instant
     assert len(final.state.sharding.device_set) == 8
+
+
+@pytest.mark.slow
+def test_stepwise_donated_ticks_match_scan(mesh8):
+    """The tick-at-a-time host loop with a donated carry (what
+    scripts/sharded_scale_proof.py --stepwise runs at N=65,536, where the
+    scan/while_loop working set OOMs the emulating host) must reproduce the
+    lax.scan trajectory exactly."""
+    from kaboodle_tpu.parallel import make_sharded_tick
+    from kaboodle_tpu.sim.scenario import all_fault_paths_scenario
+
+    n, ticks = 64, 4
+    cfg = SwimConfig()
+    sched = all_fault_paths_scenario(n, ticks=ticks, drop_rate=0.0).build()
+
+    scan_final, _ = simulate_sharded(
+        shard_state(init_state(n, seed=0), mesh8),
+        shard_inputs(sched, mesh8, stacked=True), cfg, mesh8, faulty=True,
+    )
+    ftick = jax.jit(make_sharded_tick(cfg, mesh8, faulty=True), donate_argnums=0)
+    st = shard_state(init_state(n, seed=0), mesh8)
+    for t in range(ticks):
+        st, _ = ftick(st, shard_inputs(jax.tree.map(lambda x: x[t], sched), mesh8))
+    _assert_states_equal(scan_final, st)

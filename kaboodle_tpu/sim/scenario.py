@@ -158,22 +158,27 @@ class Scenario:
         )
 
 
-def all_fault_paths_scenario(n: int, ticks: int = 1, drop_rate: float = 0.1) -> Scenario:
+def all_fault_paths_scenario(
+    n: int, ticks: int = 1, drop_rate: float = 0.1, revive: bool = True
+) -> Scenario:
     """Every fault path live in one schedule: kill, revive/restart, 2-way
     partition over the first half, random drop, and a manual ping per tick.
 
     The single source for "exercise the whole faulty program" shapes — used by
     the driver dry run (__graft_entry__.dryrun_multichip) and the sharded
     scale proof (scripts/sharded_scale_proof.py) so the two validate the same
-    program.
+    program. ``revive=False`` drops only the revive event (whose rejoin runs
+    the join-gossip path — the working set that exceeds the emulating host at
+    N=65,536; the proof script's ``--no-revive``).
     """
     if n < 4:
         raise ValueError("need n >= 4 to exercise every path")
     sc = Scenario(n, ticks).kill_at(0, [1]).drop(drop_rate)
-    # Revive exercises rejoin-with-reset; on a 1-tick run reviving the killed
-    # peer would cancel the kill (revive wins in the kernel), so restart a
-    # live peer instead.
-    sc.revive_at(ticks - 1, [3] if ticks == 1 else [1])
+    if revive:
+        # Revive exercises rejoin-with-reset; on a 1-tick run reviving the
+        # killed peer would cancel the kill (revive wins in the kernel), so
+        # restart a live peer instead.
+        sc.revive_at(ticks - 1, [3] if ticks == 1 else [1])
     sc.partition_at(0, np.arange(n, dtype=np.int32) % 2, until=max(1, ticks // 2))
     for t in range(ticks):
         sc.manual_ping_at(t, 0, 2)

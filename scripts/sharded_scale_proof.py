@@ -39,8 +39,15 @@ def main() -> None:
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--devices", type=int, default=8)
     p.add_argument("--ticks", type=int, default=8)
-    p.add_argument("--boot", choices=["none", "epidemic", "broadcast"],
-                   default="epidemic")
+    p.add_argument("--boot", choices=["none", "epidemic", "broadcast", "converged"],
+                   default="epidemic",
+                   help="converged = start from the everyone-knows-everyone "
+                        "state (ring_contacts=n-1) and assert the sharded "
+                        "all-reduce convergence check over one idle tick — "
+                        "for sizes where the join-avalanche boot tick's "
+                        "8-shard working set exceeds host RAM (N=65,536 "
+                        "OOM-kills 125 GiB even stepwise; the boot-to-"
+                        "convergence proof then runs at N=32,768)")
     p.add_argument("--boot-max-ticks", type=int, default=512)
     p.add_argument("--drop-rate", type=float, default=0.05,
                    help="faulty-scan drop rate; 0 skips the [N, N] uniform "
@@ -50,6 +57,10 @@ def main() -> None:
                         "1 = a single execution reported as run_s with "
                         "compile included — for sizes where one faulty tick "
                         "costs tens of minutes on the emulating host")
+    p.add_argument("--no-revive", action="store_true",
+               help="drop the revive event from the faulty schedule so the "
+                    "join-gossip path never executes at runtime (its 8-shard "
+                    "working set is what OOMs the emulating host at N=65,536)")
     p.add_argument("--stepwise", action="store_true",
                    help="tick-at-a-time host loop with donated carries instead "
                         "of while_loop/scan: every tick's transients are freed "
@@ -112,14 +123,24 @@ def main() -> None:
             join_broadcast_enabled=not epidemic,
             backdate_gossip_inserts=not epidemic,
         )
+        ring = {"epidemic": 2, "broadcast": 0, "converged": n - 1}[args.boot]
         st0 = shard_state(
-            init_state(n, seed=0, ring_contacts=2 if epidemic else 0,
+            init_state(n, seed=0, ring_contacts=ring,
                        track_latency=not lean, instant_identity=lean,
                        timer_dtype=timer_dtype),
             mesh,
         )
         t0 = time.perf_counter()
-        if args.stepwise:
+        if args.boot == "converged":
+            # Already-full membership: one idle fault-free tick evaluates the
+            # sharded convergence check (per-shard fingerprint reduction +
+            # peer-axis all-reduce) and must report agreement immediately.
+            boot_tick = jax.jit(
+                make_sharded_tick(boot_cfg, mesh, faulty=False), donate_argnums=0
+            )
+            booted, m = boot_tick(st0, shard_inputs(idle_inputs(n), mesh))
+            conv_v, boot_ticks_v = bool(m.converged), 0
+        elif args.stepwise:
             boot_tick = jax.jit(
                 make_sharded_tick(boot_cfg, mesh, faulty=False), donate_argnums=0
             )
@@ -158,7 +179,13 @@ def main() -> None:
 
     # ---- phase 2: every-fault-path steady-state scan -----------------------
     cfg = SwimConfig()
-    sched = all_fault_paths_scenario(n, ticks=ticks, drop_rate=args.drop_rate).build()
+    # --no-revive: same schedule minus revive — a revive re-enters through the
+    # Join path, whose gossip-share working set is the N=65,536 OOM driver;
+    # the revive/join machinery itself is proven at N<=32,768 (and by the
+    # driver dry run, which keeps the full schedule).
+    sched = all_fault_paths_scenario(
+        n, ticks=ticks, drop_rate=args.drop_rate, revive=not args.no_revive
+    ).build()
 
     if args.stepwise:
         ftick = jax.jit(make_sharded_tick(cfg, mesh, faulty=True), donate_argnums=0)

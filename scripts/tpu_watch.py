@@ -190,9 +190,11 @@ def _run_group(cmd: list[str], timeout_s: int):
     import tempfile
 
     sink = tempfile.TemporaryFile(mode="w+", prefix="tpu_watch_")
+    # cwd pins the children to the repo root so `bench.py` resolves and the
+    # `-c` measure child gets kaboodle_tpu on its sys.path (not installed).
     proc = subprocess.Popen(
         cmd, stdout=sink, stderr=subprocess.STDOUT, text=True,
-        start_new_session=True,
+        start_new_session=True, cwd=str(REPO_ROOT),
     )
 
     def _read_sink() -> str:

@@ -169,6 +169,13 @@ def main() -> None:
             "converged": conv_v,
             "wall_s": round(boot_wall, 3),
         }
+        # Bank the boot result the moment it lands: a multi-hour run killed
+        # mid-faulty-phase still leaves the asserted-convergence evidence.
+        print("PHASE " + json.dumps({
+            **line["boot"],
+            "peak_rss_mib": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        }), flush=True)
         start = booted  # steady-state scan continues from the converged mesh
     else:
         start = shard_state(
@@ -193,7 +200,14 @@ def main() -> None:
         final = start
         for t in range(ticks):
             inp_t = shard_inputs(jax.tree.map(lambda x: x[t], sched), mesh)
-            final, _ = ftick(final, inp_t)
+            final, m = ftick(final, inp_t)
+            print("PHASE " + json.dumps({
+                "faulty_tick": t,
+                "messages_delivered": int(m.messages_delivered),
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "peak_rss_mib": round(
+                    resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+            }), flush=True)
         final.state.block_until_ready()
         first_wall = run_wall = time.perf_counter() - t0  # includes compile
     else:

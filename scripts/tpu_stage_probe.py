@@ -1,0 +1,185 @@
+"""On-TPU stage decomposition with scan-amortized timing.
+
+scripts/tpu_sweep_probe.py showed single-dispatch timings through the axon
+tunnel are floored at ~10-25 ms of dispatch/fetch overhead regardless of
+tensor size — so per-op costs from the round-4 microbench (TPU_WATCH.log)
+are upper bounds, not measurements. This probe wraps each tick stage in a
+32-iteration `lax.scan` with a threaded scalar carry (so iterations cannot
+be elided or reordered) and reports total/32: tunnel overhead amortizes to
+<1 ms and the number is the true on-device stage cost.
+
+Used to decide the next fusion target (PERF.md "remaining time" section).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+ITERS = 32
+out = {"ts": time.time(), "kind": "stage_probe", "iters": ITERS}
+
+
+def bank(k, v):
+    out[k] = v
+    print("STAGEPART " + json.dumps(dict(out)), flush=True)
+
+
+def timeit_scan(make_body, init_carry, reps=2):
+    """Time ITERS scanned iterations of body; returns seconds per iteration.
+
+    make_body() -> body(carry, _) -> (carry, None). The carry threads a data
+    dependence through every iteration.
+    """
+
+    @jax.jit
+    def run(c):
+        c, _ = lax.scan(make_body(), c, None, length=ITERS)
+        return c
+
+    r = run(init_carry)
+    jax.block_until_ready(r)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))  # sync via fetch
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = run(init_carry)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / (reps * ITERS)
+
+
+def probe(n):
+    sfx = f"_n{n}"
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 3, (n, n)), jnp.int8)
+    T = jnp.asarray(rng.integers(0, 100, (n, n)), jnp.int16)
+    rh = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+    v = jnp.asarray(rng.integers(0, 2, n), bool)
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.ops.fused_fp import fused_fp_count
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k
+    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion
+    from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
+
+    # -- floor: one elementwise write-sweep of S (read n^2 int8, write n^2)
+    def mk_where():
+        def body(c, _):
+            o = jnp.where(v[None, :] & (c > 0), jnp.int8(1), S)
+            return o[0, 0].astype(jnp.int32) + 1, None
+        return body
+
+    bank(f"where_int8{sfx}_ms", timeit_scan(mk_where, jnp.int32(1)) * 1e3)
+
+    # -- floor: one read-reduce of S (no [n, n] write)
+    def mk_reduce():
+        def body(c, _):
+            s = (S > (c % 2).astype(jnp.int8)).sum(axis=-1, dtype=jnp.int32)
+            return s[0], None
+        return body
+
+    bank(f"reduce_int8{sfx}_ms", timeit_scan(mk_reduce, jnp.int32(0)) * 1e3)
+
+    # -- fingerprint: fused Pallas vs jnp formulation
+    def mk_ffp():
+        def body(c, _):
+            fp, cnt = fused_fp_count(S, rh + c)
+            return fp[0], None
+        return body
+
+    bank(f"fused_fp{sfx}_ms", timeit_scan(mk_ffp, jnp.uint32(0)) * 1e3)
+
+    def mk_jfp():
+        def body(c, _):
+            m = S > 0
+            fp = jnp.sum(jnp.where(m, (rh + c)[None, :], jnp.uint32(0)),
+                         axis=-1, dtype=jnp.uint32)
+            return fp[0], None
+        return body
+
+    bank(f"jnp_fp{sfx}_ms", timeit_scan(mk_jfp, jnp.uint32(0)) * 1e3)
+
+    # -- oldest-5 draw: jnp iter vs fused Pallas
+    def mk_iter():
+        def body(key, _):
+            key, sub = jax.random.split(key)
+            tgt = choose_one_of_oldest_k(timer=T, eligible=S == 1, key=sub,
+                                         k=5, deterministic=False,
+                                         method="iter")
+            return jax.random.fold_in(key, tgt[0]), None
+        return body
+
+    bank(f"oldest5_iter{sfx}_ms",
+         timeit_scan(mk_iter, jax.random.PRNGKey(0)) * 1e3)
+
+    alive = jnp.ones((n,), bool)
+
+    def mk_fk():
+        def body(c, _):
+            idx, valid = fused_oldest_k(S, T + c.astype(jnp.int16), alive, 5)
+            return idx[0, 0] % 2, None
+        return body
+
+    try:
+        bank(f"fused_oldest_k{sfx}_ms",
+             timeit_scan(mk_fk, jnp.int32(0)) * 1e3)
+    except Exception as e:
+        bank(f"fused_oldest_k{sfx}_error", repr(e)[:200])
+
+    # -- phase-A row statistics: fused suspicion pass
+    def mk_fs():
+        def body(c, _):
+            r = fused_suspicion(S, T, alive, jnp.int32(50) + c)
+            return r[0][0] % 2, None
+        return body
+
+    try:
+        bank(f"fused_suspicion{sfx}_ms",
+             timeit_scan(mk_fs, jnp.int32(0)) * 1e3)
+    except Exception as e:
+        bank(f"fused_suspicion{sfx}_error", repr(e)[:200])
+
+    # -- the whole fault-free tick, scan-amortized (the honest per-tick cost)
+    from kaboodle_tpu.sim.runner import simulate
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    st = init_state(n, seed=0, track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
+    inp = idle_inputs(n, ticks=ITERS)
+    for name, kw in (("fused_all", dict(use_pallas_fp=True,
+                                        use_pallas_oldest_k=True,
+                                        use_pallas_suspicion=True)),
+                     ("iter", dict(use_pallas_fp=True,
+                                   oldest_k_method="iter")),
+                     ("nopallas", dict())):
+        cfg = SwimConfig(**kw)
+
+        @jax.jit
+        def run(s, i, cfg=cfg):
+            o, _ = simulate(s, i, cfg, faulty=False)
+            return o.timer.sum() + o.tick
+
+        try:
+            r = run(st, inp)
+            jax.block_until_ready(r)
+            float(jnp.asarray(r).astype(jnp.float32))
+            t0 = time.perf_counter()
+            for _ in range(2):
+                r = run(st, inp)
+            float(jnp.asarray(r).astype(jnp.float32))
+            bank(f"tick_{name}{sfx}_ms",
+                 (time.perf_counter() - t0) / (2 * ITERS) * 1e3)
+        except Exception as e:
+            bank(f"tick_{name}{sfx}_error", repr(e)[:200])
+
+
+probe(16384)
+print("STAGEJSON " + json.dumps(out), flush=True)

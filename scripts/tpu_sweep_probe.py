@@ -1,0 +1,115 @@
+"""One-off on-TPU probe: measure the raw per-pass (HBM sweep) cost at
+N=16,384 and N=32,768 so the tick's 58.5 ms (TPU_WATCH.log, round 4) can be
+decomposed against a *measured* floor instead of the analytical 10-20 ms
+estimate in PERF.md.
+
+Value-first ordering and flushed incremental prints (the TPU_BENCH_NOTES.md
+wedge contract): every line banked is kept even if the tunnel dies
+mid-probe. Host-side cost is negligible — compiles go through the tunnel's
+remote_compile and execution stays on device — so this is safe to run while
+the single-core host grinds the 65k scale proof.
+"""
+
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+out = {"ts": time.time(), "kind": "sweep_probe"}
+
+
+def bank(k, v):
+    out[k] = v
+    print("SWEEPPART " + json.dumps(dict(out)), flush=True)
+
+
+def fetch_timeit(f, *a, reps=3):
+    # axon block_until_ready does not synchronize; time via scalar fetch.
+    r = f(*a)
+    jax.block_until_ready(r)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*a)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / reps
+
+
+def probe(n):
+    sfx = f"_n{n}"
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 3, (n, n)), jnp.int8)
+    T = jnp.asarray(rng.integers(0, 100, (n, n)), jnp.int16)
+    v = jnp.asarray(rng.integers(0, 2, n), bool)
+
+    # 1. Pure elementwise sweep: read S (n^2 int8), write S' — the cheapest
+    # possible pass shape in the tick's mark-apply chains.
+    @jax.jit
+    def where_s(S, v):
+        o = jnp.where(v[None, :], jnp.int8(1), S)
+        return o.sum(dtype=jnp.int32)
+
+    bank(f"where_int8{sfx}_ms", fetch_timeit(where_s, S, v) * 1e3)
+
+    # 2. Same over the int16 timer (2x the bytes).
+    @jax.jit
+    def where_t(T, v):
+        o = jnp.where(v[None, :], jnp.int16(0), T)
+        return o.sum(dtype=jnp.int32)
+
+    bank(f"where_int16{sfx}_ms", fetch_timeit(where_t, T, v) * 1e3)
+
+    # 3. Read-only row reduction of S (no [n, n] write) — the floor for any
+    # statistics pass.
+    @jax.jit
+    def reduce_s(S):
+        return (S > 0).sum(axis=-1, dtype=jnp.int32).sum()
+
+    bank(f"reduce_int8{sfx}_ms", fetch_timeit(reduce_s, S) * 1e3)
+
+    # 4. Chained where (2 reads of S, 1 write) — does XLA fuse the chain
+    # into one sweep or materialize the intermediate?
+    @jax.jit
+    def where_chain(S, v):
+        a = jnp.where(v[None, :], jnp.int8(1), S)
+        b = jnp.where(v[:, None], jnp.int8(2), a)
+        return b.sum(dtype=jnp.int32)
+
+    bank(f"where_chain{sfx}_ms", fetch_timeit(where_chain, S, v) * 1e3)
+
+    # 5. The components at this n (whole-tick failed to compile at 32k; the
+    # per-stage kernels are small programs and may clear the helper).
+    if n > 16384:
+        from kaboodle_tpu.ops.fused_fp import fused_fp_count
+        from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
+
+        rh = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+        elig = S == 1
+        key = jax.random.PRNGKey(0)
+        try:
+            bank(f"fused_fp{sfx}_ms",
+                 fetch_timeit(functools.partial(fused_fp_count, S, rh)) * 1e3)
+        except Exception as e:  # bank the ceiling evidence, keep going
+            bank(f"fused_fp{sfx}_error", repr(e)[:200])
+        try:
+            f = jax.jit(functools.partial(
+                choose_one_of_oldest_k, k=5, deterministic=False,
+                method="iter"))
+            bank(f"oldest5_iter{sfx}_ms",
+                 fetch_timeit(lambda: f(timer=T, eligible=elig, key=key)) * 1e3)
+        except Exception as e:
+            bank(f"oldest5_iter{sfx}_error", repr(e)[:200])
+
+
+probe(16384)
+probe(32768)
+print("SWEEPJSON " + json.dumps(out), flush=True)

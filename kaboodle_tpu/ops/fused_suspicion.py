@@ -30,12 +30,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kaboodle_tpu.ops.pallas_util import pick_row_block
-from kaboodle_tpu.spec import KNOWN, WAITING_FOR_PING
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
 
 
 def _make_kernel(n: int):
     def kernel(state_ref, timer_ref, alive_ref, thr_ref,
-               cnt_ref, jstar_ref, timed_ref, cand_ref):
+               cnt_ref, jstar_ref, timed_ref, cand_ref, wfip_ref):
         S = state_ref[:].astype(jnp.int32)  # [bn, N]
         T = timer_ref[:].astype(jnp.int32)
         alive = alive_ref[:].astype(jnp.int32) > 0  # [bn, 1]
@@ -62,6 +62,12 @@ def _make_kernel(n: int):
         cand = (S == KNOWN) & (col != row)
         cand_ref[:] = jnp.max(cand.astype(jnp.int32), axis=1, keepdims=True)
 
+        # Aged WaitingForIndirectPing cells (the A2 removal set, kaboodle.rs:
+        # 617-627): their any-per-row rides this pass so the tick can gate the
+        # whole A2 write phase without an extra read of state/timer.
+        wfip = alive & (S == WAITING_FOR_INDIRECT_PING) & (T <= thr)
+        wfip_ref[:] = jnp.max(wfip.astype(jnp.int32), axis=1, keepdims=True)
+
     return kernel
 
 
@@ -77,7 +83,7 @@ def fused_suspicion(
     alive: jax.Array,
     timed_threshold: jax.Array,
     interpret: bool | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Phase-A row stats of ``(state, timer)`` in one fused pass.
 
     Args:
@@ -88,8 +94,10 @@ def fused_suspicion(
         WaitingForPing cell is timed out iff ``timer <= timed_threshold``.
 
     Returns ``(count int32 [N], jstar int32 [N] (-1 = none),
-    has_timed bool [N], has_cand bool [N])`` matching the tick kernel's jnp
-    formulation exactly (suspicion judged on alive rows only).
+    has_timed bool [N], has_cand bool [N], has_timed_wfip bool [N])``
+    matching the tick kernel's jnp formulation exactly (suspicion judged on
+    alive rows only); ``has_timed_wfip`` is the row-wise any of the A2
+    removal set (aged WaitingForIndirectPing cells).
     """
     n = state.shape[-1]
     if not pallas_suspicion_supported(n):
@@ -103,12 +111,12 @@ def fused_suspicion(
         (bn, cells), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     vec = jnp.broadcast_to(jnp.asarray(timed_threshold, jnp.int32), (n,))
-    cnt, jstar, timed, cand_ = pl.pallas_call(
+    cnt, jstar, timed, cand_, wfip = pl.pallas_call(
         _make_kernel(n),
         grid=grid,
         in_specs=[row_block(n), row_block(n), row_block(1), row_block(1)],
-        out_specs=(row_block(1),) * 4,
-        out_shape=tuple(jax.ShapeDtypeStruct((n, 1), jnp.int32) for _ in range(4)),
+        out_specs=(row_block(1),) * 5,
+        out_shape=tuple(jax.ShapeDtypeStruct((n, 1), jnp.int32) for _ in range(5)),
         interpret=interpret,
     )(state, timer, alive.astype(jnp.int32)[:, None], vec[:, None])
-    return cnt[:, 0], jstar[:, 0], timed[:, 0] > 0, cand_[:, 0] > 0
+    return cnt[:, 0], jstar[:, 0], timed[:, 0] > 0, cand_[:, 0] > 0, wfip[:, 0] > 0

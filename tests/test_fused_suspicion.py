@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_suspicion import fused_suspicion
-from kaboodle_tpu.spec import KNOWN, WAITING_FOR_PING
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
 import pytest
 
 
@@ -29,7 +29,8 @@ def _reference(state, timer, alive, thr):
             jstar[i] = cols[np.argmin(T[i, cols])]  # first min = lowest index
     eye = np.eye(n, dtype=bool)
     has_cand = ((S == KNOWN) & ~eye).any(axis=1)
-    return count, jstar, has_timed, has_cand
+    wfip = (al[:, None] & (S == WAITING_FOR_INDIRECT_PING) & (T <= int(thr))).any(axis=1)
+    return count, jstar, has_timed, has_cand, wfip
 
 
 def test_fused_matches_reference():
@@ -40,12 +41,13 @@ def test_fused_matches_reference():
             timer = jnp.asarray(rng.integers(-12, 30, (n, n)).astype(timer_dtype))
             alive = jnp.asarray(rng.random(n) < 0.85)
             thr = 9
-            fc, fj, ft, fk = fused_suspicion(state, timer, alive, thr, interpret=True)
-            rc, rj, rt, rk = _reference(state, timer, alive, thr)
+            fc, fj, ft, fk, fw = fused_suspicion(state, timer, alive, thr, interpret=True)
+            rc, rj, rt, rk, rw = _reference(state, timer, alive, thr)
             np.testing.assert_array_equal(np.asarray(fc), rc)
             np.testing.assert_array_equal(np.asarray(ft), rt)
             np.testing.assert_array_equal(np.asarray(fk), rk)
             np.testing.assert_array_equal(np.asarray(fj), rj)
+            np.testing.assert_array_equal(np.asarray(fw), rw)
 
 
 @pytest.mark.slow

@@ -1,0 +1,153 @@
+"""On-TPU A/B of the steady-state pass gating (commit f445533).
+
+The gating commit has no runtime flag (the gates are structural, bit-exact,
+and always on), so the A/B runs the SAME measure child twice: once with the
+repo at HEAD (gated) and once inside a throwaway git worktree pinned to the
+pre-gating parent commit. Both arms measure, at N=16,384 lean+int16 with the
+fused kernels on:
+
+- ``tick_converged_ms``: fault-free tick from the everyone-knows-everyone
+  agreed state (``ring_contacts=n-1``) — the workload the gating targets
+  (every gate provably closed: no suspicion activity, no KPR delivery).
+- ``tick_selfonly_ms``: fault-free tick from the self-only boot state — the
+  workload of the banked 58.5 ms round-4 capture, for continuity.
+
+Results append to TPU_WATCH.log as ``{"kind": "gate_ab", ...}``; partial
+banking via the WATCHPART protocol so a mid-measure wedge keeps the arm
+already measured. Decision rule (PERF.md): if the gated converged tick is
+not faster, revert f445533.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+LOG = str(REPO_ROOT / "TPU_WATCH.log")
+PRE_GATE_REF = "f445533^"
+WORKTREE = "/tmp/pregate_wt"
+ARM_TIMEOUT_S = 2400
+
+MEASURE = r"""
+import json, time
+import jax, jax.numpy as jnp
+
+out = {}
+class _Partial(dict):
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        print("WATCHPART " + json.dumps(dict(self)), flush=True)
+out = _Partial(out)
+
+def fetch_timeit(f, *a, reps=3):
+    # axon block_until_ready does not synchronize; time via scalar fetch.
+    r = f(*a); jax.block_until_ready(r)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*a)
+    leaf = jax.tree.leaves(r)[0]
+    float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / reps
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.runner import simulate
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+n = 16384
+kw = dict(use_pallas_fp=True)
+try:
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
+    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion  # noqa: F401
+    kw.update(use_pallas_oldest_k=True, use_pallas_suspicion=True)
+except ImportError:
+    pass
+cfg = SwimConfig(**kw)
+inp = idle_inputs(n, ticks=8)
+
+@jax.jit
+def run(s, i):
+    o, _ = simulate(s, i, cfg, faulty=False)
+    return o.timer.sum() + o.tick
+
+for name, ring in (("converged", n - 1), ("selfonly", 0)):
+    st = init_state(n, seed=0, ring_contacts=ring, track_latency=False,
+                    instant_identity=True, timer_dtype=jnp.int16)
+    sec = fetch_timeit(run, st, inp, reps=3)
+    out[f"tick_{name}_ms"] = sec / 8 * 1e3
+
+print("WATCHJSON " + json.dumps(dict(out)))
+"""
+
+
+def log(obj) -> None:
+    with open(LOG, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+
+
+def _arm(cwd: str) -> dict:
+    # Same process-group/hard-timeout discipline as tpu_watch._run_group, but
+    # with a caller-chosen cwd (each arm imports kaboodle_tpu from its own
+    # checkout) and WATCHPART/WATCHJSON parsing inline.
+    import os
+    import signal
+    import tempfile
+
+    sink = tempfile.TemporaryFile(mode="w+", prefix="gate_ab_")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", MEASURE], stdout=sink, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True, cwd=cwd,
+    )
+    try:
+        proc.wait(timeout=ARM_TIMEOUT_S)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        rc = None
+    sink.flush()
+    sink.seek(0)
+    out = sink.read()
+    sink.close()
+    for line in reversed(out.splitlines()):
+        for tag in ("WATCHJSON ", "WATCHPART "):
+            if line.startswith(tag):
+                try:
+                    return {"rc": rc, **json.loads(line[len(tag):])}
+                except json.JSONDecodeError:
+                    continue
+    return {"rc": rc, "tail": out[-1200:]}
+
+
+def main() -> None:
+    rev = subprocess.run(
+        ["git", "rev-parse", "--short", PRE_GATE_REF], cwd=REPO_ROOT,
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    if not Path(WORKTREE).exists():
+        subprocess.run(
+            ["git", "worktree", "add", "--detach", WORKTREE, PRE_GATE_REF],
+            cwd=REPO_ROOT, check=True,
+        )
+    res = {"ts": time.time(), "kind": "gate_ab", "pre_gate_rev": rev}
+    res["gated"] = _arm(str(REPO_ROOT))
+    res["pregate"] = _arm(WORKTREE)
+    log(res)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Config-3 churn recovery at its BASELINE-specified scale, on the TPU.
+
+BENCH_r04_local.json's `churn_recovery` section proves re-convergence at
+N=2,048 (CPU); the throughput half (`churn_config3`) runs N=8,192 but its
+64-tick window cannot contain the ~1.5N-tick removal pipeline, so
+`reconverged_in_window` is false by construction. This probe runs the full
+recovery — churn scan + `run_until_converged` (a single jitted while_loop,
+so one dispatch for the whole calm phase) — at N=8,192 on the real chip,
+where ~13k recovery ticks are minutes, not hours.
+
+Appends ``{"kind": "recovery8192", ...}`` to TPU_WATCH.log; bench.py's
+churn-recovery section stays at N=2,048 so the CPU-fallback path never
+tries an O(N^3) loop on the host.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+LOG = str(REPO_ROOT / "TPU_WATCH.log")
+
+
+def main() -> None:
+    from bench import _bench_churn_recovery, _bench_partition_heal
+
+    out = {"ts": time.time(), "kind": "recovery8192"}
+    for name, fn, n in (("churn_recovery", _bench_churn_recovery, 8192),
+                        ("partition_heal", _bench_partition_heal, 8192)):
+        try:
+            t0 = time.perf_counter()
+            out[name] = fn(n)
+            out[name]["wall_s"] = round(time.perf_counter() - t0, 3)
+        except Exception as e:  # bank the failure; the other section may land
+            out[f"{name}_error"] = repr(e)[:300]
+        with open(LOG, "a") as f:
+            f.write(json.dumps(out) + "\n")
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

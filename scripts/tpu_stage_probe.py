@@ -32,25 +32,29 @@ def bank(k, v):
     print("STAGEPART " + json.dumps(dict(out)), flush=True)
 
 
-def timeit_scan(make_body, init_carry, reps=2):
+def timeit_scan(make_body, init_carry, *arrays, reps=2):
     """Time ITERS scanned iterations of body; returns seconds per iteration.
 
-    make_body() -> body(carry, _) -> (carry, None). The carry threads a data
-    dependence through every iteration.
+    make_body(*arrays) -> body(carry, _) -> (carry, None). The carry threads
+    a data dependence through every iteration. Every [n, n]-sized operand
+    MUST be passed via ``*arrays`` (becoming jit arguments the body closes
+    over as tracers), never captured as a concrete jnp array: captured
+    arrays embed as jaxpr constants in the tunnel's remote-compile request
+    and 256 MiB bodies get HTTP 413 (same rule as tpu_watch.MEASURE).
     """
 
     @jax.jit
-    def run(c):
-        c, _ = lax.scan(make_body(), c, None, length=ITERS)
+    def run(c, *arrs):
+        c, _ = lax.scan(make_body(*arrs), c, None, length=ITERS)
         return c
 
-    r = run(init_carry)
+    r = run(init_carry, *arrays)
     jax.block_until_ready(r)
     leaf = jax.tree.leaves(r)[0]
     float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))  # sync via fetch
     t0 = time.perf_counter()
     for _ in range(reps):
-        r = run(init_carry)
+        r = run(init_carry, *arrays)
     leaf = jax.tree.leaves(r)[0]
     float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
     return (time.perf_counter() - t0) / (reps * ITERS)
@@ -71,33 +75,33 @@ def probe(n):
     from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
 
     # -- floor: one elementwise write-sweep of S (read n^2 int8, write n^2)
-    def mk_where():
+    def mk_where(S, v):
         def body(c, _):
             o = jnp.where(v[None, :] & (c > 0), jnp.int8(1), S)
             return o[0, 0].astype(jnp.int32) + 1, None
         return body
 
-    bank(f"where_int8{sfx}_ms", timeit_scan(mk_where, jnp.int32(1)) * 1e3)
+    bank(f"where_int8{sfx}_ms", timeit_scan(mk_where, jnp.int32(1), S, v) * 1e3)
 
     # -- floor: one read-reduce of S (no [n, n] write)
-    def mk_reduce():
+    def mk_reduce(S):
         def body(c, _):
             s = (S > (c % 2).astype(jnp.int8)).sum(axis=-1, dtype=jnp.int32)
             return s[0], None
         return body
 
-    bank(f"reduce_int8{sfx}_ms", timeit_scan(mk_reduce, jnp.int32(0)) * 1e3)
+    bank(f"reduce_int8{sfx}_ms", timeit_scan(mk_reduce, jnp.int32(0), S) * 1e3)
 
     # -- fingerprint: fused Pallas vs jnp formulation
-    def mk_ffp():
+    def mk_ffp(S, rh):
         def body(c, _):
             fp, cnt = fused_fp_count(S, rh + c)
             return fp[0], None
         return body
 
-    bank(f"fused_fp{sfx}_ms", timeit_scan(mk_ffp, jnp.uint32(0)) * 1e3)
+    bank(f"fused_fp{sfx}_ms", timeit_scan(mk_ffp, jnp.uint32(0), S, rh) * 1e3)
 
-    def mk_jfp():
+    def mk_jfp(S, rh):
         def body(c, _):
             m = S > 0
             fp = jnp.sum(jnp.where(m, (rh + c)[None, :], jnp.uint32(0)),
@@ -105,10 +109,10 @@ def probe(n):
             return fp[0], None
         return body
 
-    bank(f"jnp_fp{sfx}_ms", timeit_scan(mk_jfp, jnp.uint32(0)) * 1e3)
+    bank(f"jnp_fp{sfx}_ms", timeit_scan(mk_jfp, jnp.uint32(0), S, rh) * 1e3)
 
     # -- oldest-5 draw: jnp iter vs fused Pallas
-    def mk_iter():
+    def mk_iter(S, T):
         def body(key, _):
             key, sub = jax.random.split(key)
             tgt = choose_one_of_oldest_k(timer=T, eligible=S == 1, key=sub,
@@ -118,11 +122,11 @@ def probe(n):
         return body
 
     bank(f"oldest5_iter{sfx}_ms",
-         timeit_scan(mk_iter, jax.random.PRNGKey(0)) * 1e3)
+         timeit_scan(mk_iter, jax.random.PRNGKey(0), S, T) * 1e3)
 
     alive = jnp.ones((n,), bool)
 
-    def mk_fk():
+    def mk_fk(S, T, alive):
         def body(c, _):
             idx, valid = fused_oldest_k(S, T + c.astype(jnp.int16), alive, 5)
             return idx[0, 0] % 2, None
@@ -130,12 +134,12 @@ def probe(n):
 
     try:
         bank(f"fused_oldest_k{sfx}_ms",
-             timeit_scan(mk_fk, jnp.int32(0)) * 1e3)
+             timeit_scan(mk_fk, jnp.int32(0), S, T, alive) * 1e3)
     except Exception as e:
         bank(f"fused_oldest_k{sfx}_error", repr(e)[:200])
 
     # -- phase-A row statistics: fused suspicion pass
-    def mk_fs():
+    def mk_fs(S, T, alive):
         def body(c, _):
             r = fused_suspicion(S, T, alive, jnp.int32(50) + c)[:4]
             return r[0][0] % 2, None
@@ -143,7 +147,7 @@ def probe(n):
 
     try:
         bank(f"fused_suspicion{sfx}_ms",
-             timeit_scan(mk_fs, jnp.int32(0)) * 1e3)
+             timeit_scan(mk_fs, jnp.int32(0), S, T, alive) * 1e3)
     except Exception as e:
         bank(f"fused_suspicion{sfx}_error", repr(e)[:200])
 

@@ -119,12 +119,21 @@ def _gather_edge(mat: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
 def make_tick_fn(
     cfg: SwimConfig,
     faulty: bool = True,
+    _cut: str | None = None,
 ) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
     """Build the jittable tick function for a given protocol config.
 
     ``cfg`` is baked in (static): protocol constants fold into the compiled
     program. ``faulty=False`` compiles out the churn/partition/drop paths for
     the fault-free fast path (bench configs 2 and 4).
+
+    ``_cut`` is a perf-probe hook (scripts/tpu_stage_probe.py), not protocol
+    surface: a static phase label ("A", "c1", "c2", "c34", "G") that truncates
+    the compiled tick right after that phase, returning the partial state with
+    zeroed metrics. Timing successive cuts under one scan isolates each
+    phase's *in-context* cost — isolated stage microbenches mispredict what
+    XLA fuses inside the real program. ``None`` (the default, and the only
+    value any production path uses) compiles the full tick.
     """
 
     det = cfg.deterministic
@@ -295,6 +304,24 @@ def make_tick_fn(
             S, T, lat, idv = apply_marks(S, T, lat, idv, mark)
             return S, T, lat, idv, dfp, dn
 
+        def _early_return(S, T, lat, idv):
+            """_cut exit: partial state, zeroed metrics (same pytree shape)."""
+            partial = MeshState(
+                state=S, timer=T, alive=alive, identity=st.identity,
+                never_broadcast=never_b, last_broadcast=last_b,
+                kpr_partner=st.kpr_partner, kpr_fp=st.kpr_fp, kpr_n=st.kpr_n,
+                tick=t + 1, key=key_next, latency=lat, id_view=idv,
+            )
+            metrics = TickMetrics(
+                messages_delivered=jnp.zeros((), jnp.int32),
+                converged=jnp.bool_(False),
+                agree_fraction=jnp.zeros((), jnp.float32),
+                mean_membership=jnp.zeros((), jnp.float32),
+                fingerprint_min=jnp.zeros((), jnp.uint32),
+                fingerprint_max=jnp.zeros((), jnp.uint32),
+            )
+            return partial, metrics
+
         # ================= A. Active phase (kaboodle.rs:746-757) ==============
         # A1: maybe_broadcast_join (kaboodle.rs:228-251): first call always
         # broadcasts; afterwards only while lonely and rebroadcast-interval old.
@@ -421,6 +448,9 @@ def make_tick_fn(
             -1,
         )
 
+        if _cut == "A":
+            return _early_return(S, T, lat, idv)
+
         member_a = S > 0
         row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
 
@@ -526,6 +556,9 @@ def make_tick_fn(
         S, T, lat, idv, dfp1, dn1 = apply_marks_delta(S, T, lat, idv, mark1)
         fp1, n1 = fp0 + dfp1, n0 + dn1
 
+        if _cut == "c1":
+            return _early_return(S, T, lat, idv)
+
         # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
         # proxies' Pings to the suspect (kaboodle.rs:533-545).
         del_ack = ok_ping & ok_edge(ping_tgt, idx)  # tgt -> pinger
@@ -580,6 +613,9 @@ def make_tick_fn(
             lambda: fp_count(S_2, idv),
             lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
         )
+
+        if _cut == "c2":
+            return _early_return(S, T, lat, idv)
 
         # Queued: the suspect's Acks back to the proxies.
         del_pack = del_pping & ok_edge(jstar[:, None], proxies)  # [N, k]
@@ -638,6 +674,9 @@ def make_tick_fn(
             lambda S, T, lat, idv: (S, T, lat, idv),
             S, T, lat, idv,
         )
+
+        if _cut == "c34":
+            return _early_return(S, T, lat, idv)
 
         # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
         # On ticks with no join and no escalation, nothing touched the state
@@ -712,6 +751,9 @@ def make_tick_fn(
         # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
         del_kpr = has_req & ok_edge(idx, partner)
         del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
+
+        if _cut == "G":
+            return _early_return(S, T, lat, idv)
 
         def _g_apply(S, T, lat, idv):
             mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester

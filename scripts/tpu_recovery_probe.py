@@ -1,16 +1,17 @@
-"""Config-3 churn recovery at its BASELINE-specified scale, on the TPU.
+"""Config-3 churn recovery and config-5 partition heal at BASELINE scale
+(N=8,192), on the TPU — with CHUNKED calm-phase dispatches.
 
 BENCH_r04_local.json's `churn_recovery` section proves re-convergence at
-N=2,048 (CPU); the throughput half (`churn_config3`) runs N=8,192 but its
-64-tick window cannot contain the ~1.5N-tick removal pipeline, so
-`reconverged_in_window` is false by construction. This probe runs the full
-recovery — churn scan + `run_until_converged` (a single jitted while_loop,
-so one dispatch for the whole calm phase) — at N=8,192 on the real chip,
-where ~13k recovery ticks are minutes, not hours.
+N=2,048 (where bench's single jitted `run_until_converged` while_loop is
+seconds); at N=8,192 the same while_loop is one ~20k-iteration dispatch,
+and the first attempt took the axon TPU worker down with it ("TPU worker
+process crashed or restarted", TPU_WATCH.log kind=recovery8192 @05:35).
+This version keeps every dispatch bounded: the faulty scenario scan runs
+as one dispatch (64/48 ticks), then calm recovery proceeds in 256-tick
+jitted scan chunks with a host-side convergence check between chunks, so
+no single execute exceeds a few seconds and progress banks incrementally.
 
-Appends ``{"kind": "recovery8192", ...}`` to TPU_WATCH.log; bench.py's
-churn-recovery section stays at N=2,048 so the CPU-fallback path never
-tries an O(N^3) loop on the host.
+Appends ``{"kind": "recovery8192_chunked", ...}`` to TPU_WATCH.log.
 """
 
 from __future__ import annotations
@@ -24,18 +25,88 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 LOG = str(REPO_ROOT / "TPU_WATCH.log")
+CHUNK = 256
+
+
+def _calm_until_converged(st, cfg, n, budget):
+    """Fault-free calm ticks in CHUNK-sized scans until every survivor
+    agrees. Returns (ticks_used_or_None, converged)."""
+    import jax
+    import numpy as np
+
+    from kaboodle_tpu.sim.runner import simulate
+    from kaboodle_tpu.sim.state import idle_inputs
+
+    inp = idle_inputs(n, ticks=CHUNK)
+
+    @jax.jit
+    def chunk(s, i):
+        out, m = simulate(s, i, cfg, faulty=False)
+        return out, m.converged
+
+    done = 0
+    while done < budget:
+        st, conv = chunk(st, inp)
+        conv_v = np.asarray(conv)
+        if conv_v.any():
+            return st, done + int(np.argmax(conv_v)) + 1, True
+        done += CHUNK
+    return st, None, False
+
+
+def _run_config(config: int, n: int, ticks: int, stop_tick: int):
+    """Faulty scenario scan + chunked calm recovery. ``stop_tick`` is the
+    tick inside the scan when the fault schedule ends (churn stop / heal);
+    the reported re-convergence count is measured from there, matching
+    bench's churn_recovery/partition_heal semantics."""
+    import jax
+    import numpy as np
+
+    from bench import _recovery_budget, _scenario_state_and_inputs
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.runner import simulate
+
+    cfg = SwimConfig()
+    budget = _recovery_budget(n)
+    st, inp = _scenario_state_and_inputs(config, n, ticks, calm_budget=budget)
+
+    @jax.jit
+    def run(s, i):
+        out, m = simulate(s, i, cfg, faulty=True)
+        return out, m.converged
+
+    t0 = time.perf_counter()
+    out, conv = run(st, inp)
+    conv_v = np.asarray(conv)
+    in_window = ticks - stop_tick
+    if conv_v[-1]:
+        later_false = np.where(~conv_v[stop_tick:])[0]
+        reconv = int(later_false[-1] + 1) if later_false.size else 0
+        reconverged = True
+    else:
+        out, extra, reconverged = _calm_until_converged(out, cfg, n, budget)
+        reconv = in_window + extra if reconverged else None
+    alive = np.asarray(out.alive)
+    return {
+        "n": n,
+        "ticks": ticks,
+        "calm_budget": in_window + budget,
+        "reconverged": bool(reconverged),
+        "reconverge_ticks_after_stop": reconv,
+        "survivors": int(alive.sum()),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
 
 
 def main() -> None:
-    from bench import _bench_churn_recovery, _bench_partition_heal
-
-    out = {"ts": time.time(), "kind": "recovery8192"}
-    for name, fn, n in (("churn_recovery", _bench_churn_recovery, 8192),
-                        ("partition_heal", _bench_partition_heal, 8192)):
+    out = {"ts": time.time(), "kind": "recovery8192_chunked", "chunk": CHUNK}
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    # config 3: churn over the first half of a 64-tick window;
+    # config 5: drop+partition healed at tick 32 of a 48-tick window.
+    for name, config, ticks, stop in (("churn_recovery", 3, 64, 32),
+                                      ("partition_heal", 5, 48, 32)):
         try:
-            t0 = time.perf_counter()
-            out[name] = fn(n)
-            out[name]["wall_s"] = round(time.perf_counter() - t0, 3)
+            out[name] = _run_config(config, n, ticks, stop)
         except Exception as e:  # bank the failure; the other section may land
             out[f"{name}_error"] = repr(e)[:300]
         with open(LOG, "a") as f:

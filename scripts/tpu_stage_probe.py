@@ -185,5 +185,99 @@ def probe(n):
             bank(f"tick_{name}{sfx}_error", repr(e)[:200])
 
 
-probe(16384)
-print("STAGEJSON " + json.dumps(out), flush=True)
+def probe_dtype_floors(n):
+    """int8/int16/int32 where-sweeps with the MATRIX as the scan carry, so
+    the [N, N] write must materialize every iteration (a body that only
+    consumes o[0, 0] gets the whole sweep DCE'd — the first where_int8
+    number in TPU_WATCH.log has that flaw and reads ~3x high)."""
+    sfx = f"_n{n}"
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.integers(0, 2, n), bool)
+    for name, dt in (("int8", jnp.int8), ("int16", jnp.int16),
+                     ("int32", jnp.int32)):
+        M = jnp.asarray(rng.integers(0, 100, (n, n)), dt)
+
+        def mk(v, dt=dt):
+            def body(Mc, _):
+                one = jnp.ones((), dt)
+                return jnp.where(v[None, :], Mc + one, Mc), None
+            return body
+
+        @jax.jit
+        def run(Mc, v):
+            Mc, _ = lax.scan(mk(v), Mc, None, length=ITERS)
+            return Mc
+
+        try:
+            r = run(M, v)
+            jax.block_until_ready(r)
+            float(jnp.asarray(r.ravel()[0]).astype(jnp.float32))
+            t0 = time.perf_counter()
+            for _ in range(2):
+                r = run(M, v)
+            float(jnp.asarray(r.ravel()[0]).astype(jnp.float32))
+            bank(f"where_carry_{name}{sfx}_ms",
+                 (time.perf_counter() - t0) / (2 * ITERS) * 1e3)
+        except Exception as e:
+            bank(f"where_carry_{name}{sfx}_error", repr(e)[:200])
+        del M
+
+
+def probe_cuts(n, variant="fused_all"):
+    """In-context phase decomposition: time the real tick truncated after
+    each phase (kernel.make_tick_fn(_cut=...)) on the converged steady
+    state — successive diffs are what each phase actually costs inside the
+    compiled program, which isolated stage benches mispredict."""
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    sfx = f"_n{n}"
+    kw = {
+        "fused_all": dict(use_pallas_fp=True, use_pallas_oldest_k=True,
+                          use_pallas_suspicion=True),
+        "jnp": dict(),
+    }[variant]
+    cfg = SwimConfig(**kw)
+    st = init_state(n, seed=0, ring_contacts=n - 1, track_latency=False,
+                    instant_identity=True, timer_dtype=jnp.int16)
+    idle = idle_inputs(n)
+
+    for cut in ("A", "c1", "c2", "c34", "G", None):
+        tick = make_tick_fn(cfg, faulty=False, _cut=cut)
+
+        @jax.jit
+        def run(c):
+            def body(s, _):
+                s2, _m = tick(s, idle)
+                return s2, None
+            c, _ = lax.scan(body, c, None, length=ITERS)
+            return c
+
+        name = cut or "full"
+        try:
+            r = run(st)
+            jax.block_until_ready(r)
+            float(jnp.asarray(r.timer.ravel()[0]).astype(jnp.float32))
+            t0 = time.perf_counter()
+            for _ in range(2):
+                r = run(st)
+            float(jnp.asarray(r.timer.ravel()[0]).astype(jnp.float32))
+            bank(f"cut_{variant}_{name}{sfx}_ms",
+                 (time.perf_counter() - t0) / (2 * ITERS) * 1e3)
+        except Exception as e:
+            bank(f"cut_{variant}_{name}{sfx}_error", repr(e)[:200])
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "stages"):
+        probe(16384)
+    if which in ("all", "dtypes"):
+        probe_dtype_floors(16384)
+    if which in ("all", "cuts"):
+        probe_cuts(16384, "fused_all")
+        probe_cuts(16384, "jnp")
+    print("STAGEJSON " + json.dumps(out), flush=True)

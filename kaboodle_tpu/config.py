@@ -117,6 +117,15 @@ class SwimConfig:
     # (tests/test_fused_suspicion.py); same constraints as the other fused
     # kernels. bench.py enables it on the single-chip TPU path.
     use_pallas_suspicion: bool = False
+    # Fault-free builds compile a two-branch tick: a lean path for ticks with
+    # no join broadcast and no suspicion activity (the overwhelming majority
+    # of every boot/steady/calm-recovery scan) that computes all delivery
+    # masks from O(N) vectors and applies them in one composed write chain,
+    # and the full path for everything else, selected per tick by lax.cond.
+    # Bit-exact with the full path (tests/test_fast_path.py); the on-TPU
+    # phase decomposition that motivated it is in PERF.md (round 4: the full
+    # tick spends ~9 combined HBM sweeps where the lean ticks need ~3).
+    fast_path: bool = True
 
     def __post_init__(self) -> None:
         if self.oldest_k_method not in ("topk", "iter"):

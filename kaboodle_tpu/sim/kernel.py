@@ -50,6 +50,15 @@ Memory/layout notes (TPU):
   broadcast (resp. no removal).
 - Everything is static-shaped; the whole tick jits into one XLA program and
   rolls under ``lax.scan`` (runner.py).
+- Fault-free builds (``faulty=False``) compile a TWO-BRANCH tick selected by
+  ``lax.cond`` per tick: ticks with no Join broadcast and no suspicion
+  activity (``pred == False`` — the overwhelming majority of boot, steady
+  state, and calm recovery) take ``_fast``, whose delivery masks all derive
+  from O(N) vectors so the [N, N] work collapses to the stats read, the
+  eligibility/draw read, and one composed write chain; everything else takes
+  ``_rest``, the full path. The split exists because the round-4 on-TPU
+  phase decomposition (PERF.md) showed the full path's per-phase ``cond``
+  boundaries force ~9 materialized HBM sweeps where these ticks need ~3.
 """
 
 from __future__ import annotations
@@ -129,11 +138,12 @@ def make_tick_fn(
 
     ``_cut`` is a perf-probe hook (scripts/tpu_stage_probe.py), not protocol
     surface: a static phase label ("A", "c1", "c2", "c34", "G") that truncates
-    the compiled tick right after that phase, returning the partial state with
-    zeroed metrics. Timing successive cuts under one scan isolates each
+    the compiled full path right after that phase, returning the partial state
+    with zeroed metrics. Timing successive cuts under one scan isolates each
     phase's *in-context* cost — isolated stage microbenches mispredict what
     XLA fuses inside the real program. ``None`` (the default, and the only
-    value any production path uses) compiles the full tick.
+    value any production path uses) compiles the normal tick; any other value
+    also disables the fast/slow split so the probe times the full path.
     """
 
     det = cfg.deterministic
@@ -212,18 +222,44 @@ def make_tick_fn(
             def ok_outer():
                 return alive[:, None] & alive[None, :]
 
-        # Phase-A row stats. The fused path computes the membership count,
-        # the timed-out-suspect argmin, and proxy-candidate existence in one
-        # Pallas pass over (S, T); the jnp path spells the same formulas out
-        # (several fused XLA passes). S is not written between here and the
-        # A2 snapshot (A1 only touches broadcast bookkeeping vectors).
+        # ---- Phase-A row stats on the pre-tick snapshot ----------------------
+        # (the oracle's handle_suspected_peers iterates a snapshot taken at
+        # entry, kaboodle.rs:558-653). The fused path computes the membership
+        # count, the timed-out-suspect argmin, and proxy-candidate existence in
+        # one Pallas pass over (S, T); the jnp path spells the same formulas
+        # out (several fused XLA passes). Nothing writes S/T before the A2
+        # apply, so the snapshot is just an alias.
+        S0, T0 = S, T
+        age0 = t - T0
         use_fused_susp = cfg.use_pallas_suspicion and pallas_suspicion_supported(n)
         if use_fused_susp:
-            row_count0, jstar, has_timed, has_cand, wfip_any = fused_suspicion(
+            row_count0, jstar_pre, has_timed, has_cand_pre, wfip_any = fused_suspicion(
                 S, T, alive, t - cfg.ping_timeout_ticks
             )
         else:
+            # Only what the fast/slow dispatch pred and A1 need is computed
+            # here (the fewest sibling reductions over one (S, T) read); the
+            # slow-path-only stats — the escalation argmin and the proxy-
+            # candidate test — are recomputed inside _rest, off the fast
+            # ticks entirely.
             row_count0 = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
+            has_timed = jnp.any(
+                alive[:, None] & (S0 == WAITING_FOR_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                ),
+                axis=-1,
+            )
+            wfip_any = jnp.any(
+                alive[:, None]
+                & (S0 == WAITING_FOR_INDIRECT_PING)
+                & (age0 >= cfg.ping_timeout_ticks),
+                axis=-1,
+            )
+            jstar_pre = has_cand_pre = None
+        # any(insta_remove) | any(escalate) == any(has_timed): the dispatch
+        # pred does not need the has_cand split.
+        any_a2 = jnp.any(wfip_any) | jnp.any(has_timed)
+
         # Q6 insert stamp offset, shared by the join-gossip and anti-entropy
         # reply inserts (0 = the epidemic-boot extension, config.py).
         gossip_backdate = (
@@ -231,6 +267,7 @@ def make_tick_fn(
         )
         rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
         u_row = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (n, n))
+        INF = jnp.int32(_I32MAX)
 
         def fp_count(S_now, idv_now):
             """Row fingerprints + membership counts at a point in the tick.
@@ -333,530 +370,722 @@ def make_tick_fn(
             )
             last_b = jnp.where(join_b, t, last_b)
             never_b = never_b & ~join_b
+            any_join = jnp.any(join_b)
         else:
             join_b = jnp.zeros((n,), dtype=bool)
-
-        # A2: handle_suspected_peers (kaboodle.rs:558-653) on the pre-tick
-        # snapshot (the oracle iterates a snapshot taken at entry).
-        S0, T0 = S, T
-        age0 = t - T0
-        if not use_fused_susp:
-            timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (
-                age0 >= cfg.ping_timeout_ticks
-            )
-            has_timed = jnp.any(timed_wfp, axis=-1)
-            # D1: escalate exactly one — the oldest, ties toward lower index.
-            tsel = jnp.where(timed_wfp, T0, TMAX)
-            min_t = jnp.min(tsel, axis=-1)
-            jstar_mask = timed_wfp & (T0 == min_t[:, None])
-            jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
-            jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
-
-            # Proxy candidates: Known peers other than self, from the same
-            # snapshot (kaboodle.rs:595-605; the suspect itself is
-            # WaitingForPing, excluded).
-            has_cand = jnp.any((S0 == KNOWN) & ~eye, axis=-1)
-            wfip_any = jnp.any(
-                alive[:, None]
-                & (S0 == WAITING_FOR_INDIRECT_PING)
-                & (age0 >= cfg.ping_timeout_ticks),
-                axis=-1,
-            )
-        escalate = has_timed & has_cand
-        insta_remove = has_timed & ~has_cand  # no proxies -> drop now (:599-605)
-
-        # Escalations are rare (none at all in fault-free steady state), so the
-        # [N, N] gumbel + top_k proxy draw is gated; the zero indices in the
-        # skip branch are inert because proxies_valid is all-False then. The
-        # skip branch derives its shapes from the draw itself so the two
-        # branches cannot drift apart.
-        def _draw_proxies():
-            # The candidate matrix lives only inside this rare branch (the
-            # fused-suspicion path never materializes it outside).
-            known_cand = (S0 == KNOWN) & ~eye
-            return choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det)
-
-        proxies, proxies_valid = jax.lax.cond(
-            jnp.any(escalate),
-            _draw_proxies,
-            lambda: jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype), jax.eval_shape(_draw_proxies)
-            ),
-        )  # [N, k]
-        proxies_valid &= escalate[:, None]
-
-        # WaitingForIndirectPing timeouts -> removal (kaboodle.rs:617-627),
-        # judged on the same pre-tick snapshot (an entry escalated this tick is
-        # not removed this tick). The whole A2 write phase is a no-op on
-        # suspicion-free ticks (all of fault-free steady state), so the [N, N]
-        # write pass is gated out of them; the removal mask is rebuilt inside
-        # each gated consumer so it is never materialized on clean ticks.
-        jstar_cell = idx[None, :] == jstar[:, None]
-        any_rem = jnp.any(wfip_any) | jnp.any(insta_remove)
-        any_a2 = any_rem | jnp.any(escalate)
-
-        def _a2_rem():
-            r = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (
-                age0 >= cfg.ping_timeout_ticks
-            )
-            return r | (insta_remove[:, None] & jstar_cell)
-
-        def _a2_apply(S, T, lat):
-            rem = _a2_rem()
-            S = jnp.where(rem, jnp.int8(0), S)
-            if has_lat:
-                # _remove drops the whole record: a re-learned peer starts with
-                # no latency history (kaboodle.rs:643-644).
-                lat = jnp.where(rem, jnp.nan, lat)
-            # The accompanying Failed broadcasts are inert in the reference
-            # (quirk Q3) — modeled only in intended-semantics mode below.
-            esc_cell = escalate[:, None] & jstar_cell
-            S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
-            T = jnp.where(esc_cell, tT, T)
-            return S, T, lat
-
-        S, T, lat = jax.lax.cond(
-            any_a2, _a2_apply, lambda S, T, lat: (S, T, lat), S, T, lat
-        )
-
-        # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
-        if cfg.use_pallas_oldest_k and pallas_oldest_k_supported(n):
-            # Fused path: eligibility + all k rounds in one pass over
-            # state/timer tiles — no [N, N] eligibility mask materialized.
-            kk = 1 if det else cfg.num_candidate_target_peers
-            cand_idx, cand_valid = fused_oldest_k(S, T, alive, kk)
-            ping_tgt = choose_among_candidates(cand_idx, cand_valid, key_ping, det)
-        else:
-            elig = alive[:, None] & (S == KNOWN) & ~eye
-            ping_tgt = choose_one_of_oldest_k(
-                T, elig, cfg.num_candidate_target_peers, key_ping, det,
-                method=cfg.oldest_k_method,
-            )
-        has_ping = ping_tgt >= 0
-        tgt_cell = _row_mark(idx, ping_tgt, has_ping)
-        S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
-        T = jnp.where(tgt_cell, tT, T)
+            any_join = jnp.bool_(False)
 
         # A4: manual pings (ping_addrs, kaboodle.rs:550-556): no state change at
         # the sender. Self-pings and out-of-range targets are dropped at the
         # transport (deviation D8, matching LockstepMesh._deliver_round's
         # ``0 <= dest < n`` guard — without this, clamped gathers would fake
-        # an exchange with peer N-1).
+        # an exchange with peer N-1). Shared by both tick branches.
         man_tgt = jnp.where(
             alive & (inp.manual_target != idx) & (inp.manual_target < n),
             inp.manual_target,
             -1,
         )
 
-        if _cut == "A":
-            return _early_return(S, T, lat, idv)
+        def _anti_entropy(S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g):
+            """Call-G apply (kaboodle.rs:707-740), shared by both branches.
 
-        member_a = S > 0
-        row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
+            Requests only flow while fingerprints disagree, so every call-G
+            [N, N] pass — the marks, the share gather/insert, and the final
+            fingerprint read — is gated on a request actually being delivered:
+            on a converged steady-state tick nothing in here touches the
+            state and fp_f is exactly fp_g."""
 
-        # ================= B. Broadcast delivery (kaboodle.rs:256-311) ========
-        # Join o accepted at r: Jm[r, o]. Receivers insert the joiner as
-        # Known(now) with the broadcast identity, preserving a prior latency
-        # (kaboodle.rs:284-304, :291-297).
-        if cfg.join_broadcast_enabled:
-            Jm = join_b[None, :] & ok_outer().T & ~eye  # [receiver, origin]
-            is_new_ro = Jm & ~member_a
-            S = jnp.where(Jm, jnp.int8(KNOWN), S)
-            T = jnp.where(Jm, tT, T)
-            if has_idv:
-                idv = jnp.where(Jm, id_row, idv)
-        else:
-            Jm = jnp.zeros((n, n), dtype=bool)
-            is_new_ro = Jm
+            def _g_apply(S, T, lat, idv):
+                mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
 
-        if not cfg.faithful_failed_broadcast:
-            # Failed(j) broadcast by i, delivered to r (r != j): remove j.
-            # Broadcasts resolve in origin order (the lockstep contract), so a
-            # same-tick Join(j) wins only against Failed origins i < j; any
-            # delivering Failed origin i > j removes j after the re-insert.
-            # (When Join(j) was not delivered at r, any Failed origin removes.)
-            # O(N^3) matmuls, so skipped on removal-free ticks like the gossip
-            # union below.
-            def _fail_del(_):
+                # Filtered reply share (kaboodle.rs:483-501): Known peers heard
+                # from strictly within MAX_PEER_SHARE_AGE, excluding self (and
+                # the requester — enforced receiver-side as j != i, same
+                # effect). Computed post-marks, matching the oracle's two-pass
+                # delivery. Not capped (Q12). The share snapshot is taken
+                # before the requester-marks-partner write below (the oracle's
+                # two-pass order): a partner's own fresh call-G marks must not
+                # leak into the rows it shares this tick.
+                S_share, T_share = S, T
+                mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
+                S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
+                T = jnp.where(mark_rep, tT, T)
+
+                def _kpr_reply_insert(S, T, idv):
+                    share_f = (S_share == KNOWN) & ~eye & (
+                        (t - T_share) < cfg.max_peer_share_age_ticks
+                    )
+                    srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
+                    rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
+                    S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
+                    T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
+                    if has_idv:
+                        # The reply carries (addr, identity) records
+                        # (structs.rs:110); identity words resolve to the
+                        # peers' current identities (D-ID1, like the
+                        # join-gossip insert in _rest). Without this, a row
+                        # re-filled after a revive keeps placeholder words and
+                        # its fingerprint can never agree.
+                        idv = jnp.where(rep_ins, id_row, idv)
+                    return S2, T2, idv
+
+                S, T, idv = jax.lax.cond(
+                    jnp.any(del_rep),
+                    _kpr_reply_insert,
+                    lambda S, T, idv: (S, T, idv),
+                    S, T, idv,
+                )
+                fp_f, n_f = fp_count(S, idv)
+                return S, T, lat, idv, fp_f, n_f
+
+            return jax.lax.cond(
+                jnp.any(del_kpr),
+                _g_apply,
+                lambda S, T, lat, idv: (S, T, lat, idv, fp_g, n_g),
+                S, T, lat, idv,
+            )
+
+        def _ae_phase01(fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt):
+            """Anti-entropy candidate phases 0-1, shared by both branches
+            (kaboodle.rs:707-740 take_sync_request order). Phase 0: last
+            tick's KnownPeersRequest senders (their candidates were recorded
+            before this tick's acks arrived); phase 1: this tick's call-2
+            direct + manual acks, sender == acked peer. Returns
+            ``(prio0, peer0, prio1, peer1)``; phases 2-3 are escalation-borne
+            and exist only in the full path."""
+            m0 = (st.kpr_partner[None, :] == idx[:, None]) & alive[:, None] & ~rv[:, None]
+            match0 = m0 & (st.kpr_fp[None, :] != fp_g[:, None]) & (
+                n_g[:, None] <= st.kpr_n[None, :]
+            )
+            prio0 = jnp.min(jnp.where(match0, idx[None, :], INF), axis=-1)
+            peer0 = prio0  # sender == candidate peer for KPR candidates
+
+            base1 = jnp.int32(n)
+            m_d = del_ack & (fp1[jnp.clip(ping_tgt, 0)] != fp_g) & (
+                n_g <= n1[jnp.clip(ping_tgt, 0)]
+            )
+            m_m = del_ack_man & (fp1[jnp.clip(man_tgt, 0)] != fp_g) & (
+                n_g <= n1[jnp.clip(man_tgt, 0)]
+            )
+            prio_d = jnp.where(m_d, base1 + ping_tgt, INF)
+            prio_m = jnp.where(m_m, base1 + man_tgt, INF)
+            prio1 = jnp.minimum(prio_d, prio_m)
+            peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
+            return prio0, peer0, prio1, peer1
+
+        def _finish(S, T, lat, idv, kpr_partner_new, fp_g, n_g, fp_f, n_f, msgs):
+            """Metrics + next-state assembly, shared by both branches."""
+            fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
+            fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
+            n_alive = jnp.sum(alive, dtype=jnp.int32)
+            converged = (fpa_min == fpa_max) & (n_alive > 0)
+            agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
+
+            new_state = MeshState(
+                state=S,
+                timer=T,
+                alive=alive,
+                identity=st.identity,
+                never_broadcast=never_b,
+                last_broadcast=last_b,
+                kpr_partner=kpr_partner_new,
+                kpr_fp=fp_g,
+                kpr_n=n_g,
+                tick=t + 1,
+                key=key_next,
+                latency=lat,
+                id_view=idv,
+            )
+            metrics = TickMetrics(
+                messages_delivered=msgs,
+                converged=converged,
+                agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
+                mean_membership=jnp.sum(jnp.where(alive, n_f, 0)).astype(jnp.float32)
+                / jnp.maximum(n_alive, 1),
+                fingerprint_min=fpa_min,
+                fingerprint_max=fpa_max,
+            )
+            return new_state, metrics
+
+        def _rest(S=S, T=T, lat=lat, idv=idv):
+            """The full tick body: A2 suspicion handling onward. Taken by
+            every faulty-build tick, and by fault-free ticks with a Join
+            broadcast or suspicion activity (``pred`` in the dispatch below).
+            The default args freeze the post-churn/post-A1 tensors."""
+            # Slow-path-only phase-A stats (kaboodle.rs:558-653), recomputed
+            # here from the same pre-tick snapshot so fast ticks never pay
+            # for them. D1: escalate exactly one — the oldest timed-out
+            # WaitingForPing entry, ties toward lower index; proxy candidates
+            # are Known peers other than self (kaboodle.rs:595-605; the
+            # suspect itself is WaitingForPing, excluded).
+            if use_fused_susp:
+                jstar, has_cand = jstar_pre, has_cand_pre
+            else:
+                timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                )
+                tsel = jnp.where(timed_wfp, T0, TMAX)
+                min_t = jnp.min(tsel, axis=-1)
+                jstar_mask = timed_wfp & (T0 == min_t[:, None])
+                jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
+                jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
+                has_cand = jnp.any((S0 == KNOWN) & ~eye, axis=-1)
+            escalate = has_timed & has_cand
+            insta_remove = has_timed & ~has_cand  # no proxies -> drop (:599-605)
+            jstar_cell = idx[None, :] == jstar[:, None]
+            any_rem = jnp.any(wfip_any) | jnp.any(insta_remove)
+
+            # A2: handle_suspected_peers (kaboodle.rs:558-653) on the pre-tick
+            # snapshot. Escalations are rare (none at all in fault-free steady
+            # state), so the [N, N] gumbel + top_k proxy draw is gated; the
+            # zero indices in the skip branch are inert because proxies_valid
+            # is all-False then. The skip branch derives its shapes from the
+            # draw itself so the two branches cannot drift apart.
+            def _draw_proxies():
+                # The candidate matrix lives only inside this rare branch (the
+                # fused-suspicion path never materializes it outside).
+                known_cand = (S0 == KNOWN) & ~eye
+                return choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det)
+
+            proxies, proxies_valid = jax.lax.cond(
+                jnp.any(escalate),
+                _draw_proxies,
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), jax.eval_shape(_draw_proxies)
+                ),
+            )  # [N, k]
+            proxies_valid &= escalate[:, None]
+
+            # WaitingForIndirectPing timeouts -> removal (kaboodle.rs:617-627),
+            # judged on the same pre-tick snapshot (an entry escalated this
+            # tick is not removed this tick). The whole A2 write phase is a
+            # no-op on suspicion-free ticks, so the [N, N] write pass is gated
+            # out of them; the removal mask is rebuilt inside each gated
+            # consumer so it is never materialized on clean ticks.
+            def _a2_rem():
+                r = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                )
+                return r | (insta_remove[:, None] & jstar_cell)
+
+            def _a2_apply(S, T, lat):
                 rem = _a2_rem()
-                rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
-                fail_gt = _bool_matmul(ok_outer().T, rem_gt)  # [r, j]
-                fail_any = _bool_matmul(ok_outer().T, rem)  # [r, j]
-                return ~eye & jnp.where(Jm, fail_gt, fail_any)
+                S = jnp.where(rem, jnp.int8(0), S)
+                if has_lat:
+                    # _remove drops the whole record: a re-learned peer starts
+                    # with no latency history (kaboodle.rs:643-644).
+                    lat = jnp.where(rem, jnp.nan, lat)
+                # The accompanying Failed broadcasts are inert in the reference
+                # (quirk Q3) — modeled only in intended-semantics mode below.
+                esc_cell = escalate[:, None] & jstar_cell
+                S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
+                T = jnp.where(esc_cell, tT, T)
+                return S, T, lat
 
-            fail_del = jax.lax.cond(
-                any_rem,
-                _fail_del,
-                lambda _: jnp.zeros((n, n), dtype=bool),
-                operand=None,
+            S, T, lat = jax.lax.cond(
+                any_a2, _a2_apply, lambda S, T, lat: (S, T, lat), S, T, lat
             )
-            S = jnp.where(fail_del, jnp.int8(0), S)
+
+            # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
+            if cfg.use_pallas_oldest_k and pallas_oldest_k_supported(n):
+                # Fused path: eligibility + all k rounds in one pass over
+                # state/timer tiles — no [N, N] eligibility mask materialized.
+                kk = 1 if det else cfg.num_candidate_target_peers
+                cand_idx, cand_valid = fused_oldest_k(S, T, alive, kk)
+                ping_tgt = choose_among_candidates(cand_idx, cand_valid, key_ping, det)
+            else:
+                elig = alive[:, None] & (S == KNOWN) & ~eye
+                ping_tgt = choose_one_of_oldest_k(
+                    T, elig, cfg.num_candidate_target_peers, key_ping, det,
+                    method=cfg.oldest_k_method,
+                )
+            has_ping = ping_tgt >= 0
+            tgt_cell = _row_mark(idx, ping_tgt, has_ping)
+            S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
+            T = jnp.where(tgt_cell, tT, T)
+
+            if _cut == "A":
+                return _early_return(S, T, lat, idv)
+
+            member_a = S > 0
+            row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
+
+            # ============= B. Broadcast delivery (kaboodle.rs:256-311) ========
+            # Join o accepted at r: Jm[r, o]. Receivers insert the joiner as
+            # Known(now) with the broadcast identity, preserving a prior
+            # latency (kaboodle.rs:284-304, :291-297).
+            if cfg.join_broadcast_enabled:
+                Jm = join_b[None, :] & ok_outer().T & ~eye  # [receiver, origin]
+                is_new_ro = Jm & ~member_a
+                S = jnp.where(Jm, jnp.int8(KNOWN), S)
+                T = jnp.where(Jm, tT, T)
+                if has_idv:
+                    idv = jnp.where(Jm, id_row, idv)
+            else:
+                Jm = jnp.zeros((n, n), dtype=bool)
+                is_new_ro = Jm
+
+            if not cfg.faithful_failed_broadcast:
+                # Failed(j) broadcast by i, delivered to r (r != j): remove j.
+                # Broadcasts resolve in origin order (the lockstep contract),
+                # so a same-tick Join(j) wins only against Failed origins
+                # i < j; any delivering Failed origin i > j removes j after
+                # the re-insert. (When Join(j) was not delivered at r, any
+                # Failed origin removes.) O(N^3) matmuls, so skipped on
+                # removal-free ticks like the gossip union below.
+                def _fail_del(_):
+                    rem = _a2_rem()
+                    rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
+                    fail_gt = _bool_matmul(ok_outer().T, rem_gt)  # [r, j]
+                    fail_any = _bool_matmul(ok_outer().T, rem)  # [r, j]
+                    return ~eye & jnp.where(Jm, fail_gt, fail_any)
+
+                fail_del = jax.lax.cond(
+                    any_rem,
+                    _fail_del,
+                    lambda _: jnp.zeros((n, n), dtype=bool),
+                    operand=None,
+                )
+                S = jnp.where(fail_del, jnp.int8(0), S)
+                if has_lat:
+                    lat = jnp.where(fail_del, jnp.nan, lat)
+
+            # Join responses (kaboodle.rs:333-392): r replies to each *new*
+            # joiner with probability max(1, 100-n^2)% where n tracks the
+            # sequentially growing map (cumulative inserts in origin order —
+            # exact parity), and the accepted replies union into a gossip
+            # share at the joiner. The whole block — [N, N] cumsums, the
+            # Bernoulli draw, and the two boolean matmuls — is gated on a
+            # join actually happening this tick (steady-state ticks have
+            # none); the skip branch's all-False outputs are exactly what the
+            # formulas produce with join_b all-False. With broadcasts
+            # compiled out there is never a join, so the gate is static.
+            def _join_replies():
+                n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
+                reply_p = broadcast_reply_prob(n_after)
+                bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
+                reply = is_new_ro & bern  # [r, o]
+                reply_del_ = reply & ok_outer()  # response unicast r -> o gated like any message
+
+                # Gossip union at joiner o (deliverable in call 2): the reply
+                # share is r's map at reply time = start-of-round map +
+                # joiners accepted with origin index <= o (the oracle's
+                # sequential processing order):
+                #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
+                share_base = member_a
+                if cfg.max_share_peers and n > cfg.max_share_peers:
+                    # D5: cap to lowest-index members of the start-of-round map.
+                    within_cap = (
+                        jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+                    )
+                    share_base = member_a & within_cap
+                term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
+                term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
+                tri = idx[None, :] <= idx[:, None]  # j <= o
+                return reply_del_, term1 | (term2 & tri)
+
+            if cfg.join_broadcast_enabled:
+                reply_del, gossip = jax.lax.cond(
+                    any_join,
+                    _join_replies,
+                    lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
+                )
+            else:
+                reply_del = gossip = jnp.zeros((n, n), dtype=bool)
+
+            # ============= Call 1: Pings + PingRequests =======================
+            ok_ping = has_ping & ok_edge(idx, ping_tgt)
+            ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
+            del_pr = proxies_valid & ok_edge(idx[:, None], proxies)  # [N, k]
+
+            # mark1[dest, sender]: dense one-hot compares (no scatter) — each
+            # term fuses into apply_marks' where pass. The proxy terms are
+            # all-False on escalation-free ticks but cost only fused compares,
+            # not a gather.
+            mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
+            for kk in range(proxies.shape[-1]):
+                mark1 |= _col_mark(idx, proxies[:, kk], del_pr[:, kk])
+            # Base fingerprint once (post-A3: the A3 WaitingForPing write moves
+            # no membership and no identity word, so this equals the pre-mark1
+            # fp); every later fp point derives by exact per-wave deltas on
+            # the fast path, with full recomputes only inside the
+            # join/escalation branches.
+            fp0, n0 = fp_count(S, idv)
+            S, T, lat, idv, dfp1, dn1 = apply_marks_delta(S, T, lat, idv, mark1)
+            fp1, n1 = fp0 + dfp1, n0 + dn1
+
+            if _cut == "c1":
+                return _early_return(S, T, lat, idv)
+
+            # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and
+            # the proxies' Pings to the suspect (kaboodle.rs:533-545).
+            del_ack = ok_ping & ok_edge(ping_tgt, idx)  # tgt -> pinger
+            del_ack_man = ok_man & ok_edge(man_tgt, idx)
+            ok_p2x = ok_edge(proxies, jstar[:, None])  # proxy -> suspect
+            del_pping = del_pr & ok_p2x  # [N, k]
+
+            # ============= Call 2: Acks, proxy Pings, join responses ==========
+            mark2 = _row_mark(idx, ping_tgt, del_ack)  # pinger marks target
+            mark2 |= _row_mark(idx, man_tgt, del_ack_man)
+            mark2 |= reply_del.T  # joiner marks join-responder
+            # Suspect-marks-proxy scatters on BOTH dims (jstar rows x proxy
+            # cols), so it has no one-hot form; it is escalation-only, so gate
+            # the scatter out of steady-state ticks.
+            mark2 |= jax.lax.cond(
+                jnp.any(escalate),
+                lambda: _scatter_or(
+                    jnp.zeros((n, n), dtype=bool),
+                    jnp.broadcast_to(jstar[:, None], proxies.shape),
+                    proxies,
+                    del_pping,
+                ),
+                lambda: jnp.zeros((n, n), dtype=bool),
+            )
+            S, T, lat, idv, dfp2, dn2 = apply_marks_delta(S, T, lat, idv, mark2)
+
+            # Gossip-learned peers insert back-dated (Q6) where still unknown,
+            # with identity words resolved to the peers' current identities
+            # (deviation D-ID1 — shared with the lockstep oracle; the native
+            # engine carries the sharer's view faithfully).
+            if cfg.join_broadcast_enabled:
+
+                def _gossip_insert(S, T, idv):
+                    gossip_new = gossip & ~(S > 0)
+                    S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
+                    T = jnp.where(gossip_new, tT - gossip_backdate, T)
+                    if has_idv:
+                        idv = jnp.where(gossip_new, id_row, idv)
+                    return S, T, idv
+
+                S, T, idv = jax.lax.cond(
+                    any_join, _gossip_insert, lambda S, T, idv: (S, T, idv), S, T, idv
+                )
+
+            # fp2/n2 feed only the indirect-ping ack payloads (call-3 acks at
+            # proxies, call-4 forwards) — every consumer is masked by an
+            # escalation-derived delivery, so the whole O(N^2) hash pass is
+            # gated off on escalation-free ticks (all of fault-free steady
+            # state).
+            S_2, idv_2 = S, idv
+            fp2, n2 = jax.lax.cond(
+                jnp.any(escalate),
+                lambda: fp_count(S_2, idv_2),
+                lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
+            )
+
+            if _cut == "c2":
+                return _early_return(S, T, lat, idv)
+
+            # Queued: the suspect's Acks back to the proxies.
+            del_pack = del_pping & ok_edge(jstar[:, None], proxies)  # [N, k]
+
+            # Coincidence forwarding (kaboodle.rs:418-443 pop semantics): if
+            # proxy p's own direct or manual ping this tick targeted the same
+            # suspect, p's call-2 Ack for it pops the curious entry and
+            # forwards fp1-payload Acks in call 3; the call-3 proxy Ack then
+            # finds curious empty.
+            p_tgt = ping_tgt[jnp.clip(proxies, 0)]  # [N, k] the proxies' own ping targets
+            p_man = man_tgt[jnp.clip(proxies, 0)]
+            p_got_direct = del_ack[jnp.clip(proxies, 0)]
+            p_got_man = del_ack_man[jnp.clip(proxies, 0)]
+            pop_hit = ((p_tgt == jstar[:, None]) & p_got_direct) | (
+                (p_man == jstar[:, None]) & p_got_man
+            )
+            fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
+            del_fwd_c = fwd_c & ok_edge(proxies, idx[:, None])  # p -> suspector
+
+            # Proxy forwards the suspect's Ack (fp2 payload) in call 4 unless
+            # the curious entry was already popped by the call-2 coincidence.
+            fwd = del_pack & ~pop_hit
+            del_fwd = fwd & ok_edge(proxies, idx[:, None])  # [N, k] p -> suspector
+
+            # ======== Calls 3 + 4: escalation-only delivery waves =============
+            # Call 3: suspect Acks at proxies; call 4: forwarded Acks. Every
+            # datagram in these waves descends from an escalation this tick,
+            # so the mark scatters and full-matrix where-passes are gated out
+            # of escalation-free ticks (all of fault-free steady state).
+            def _calls34(S, T, lat, idv):
+                mark3 = jnp.zeros((n, n), dtype=bool)
+                mark3 = _scatter_or(
+                    mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
+                )  # proxy marks suspect — the proxy's own view resurrects (Q1)
+                mark3 = _scatter_or(
+                    mark3, idx[:, None], proxies, del_fwd_c
+                )  # suspector marks pinger-proxy
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
+
+                # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is
+                # the proxy, so the suspector marks the proxy — the suspect
+                # stays WaitingForIndirectPing (kaboodle.rs:408-415 applies to
+                # the sender).
+                mark4 = jnp.zeros((n, n), dtype=bool)
+                mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
+                if not cfg.faithful_indirect_ack:
+                    # Intended-SWIM mode: a forwarded ack clears the suspect too.
+                    cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
+                    clr_cell = cleared[:, None] & jstar_cell & (S > 0)
+                    S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
+                    T = jnp.where(clr_cell, tT, T)
+                return S, T, lat, idv
+
+            S, T, lat, idv = jax.lax.cond(
+                jnp.any(escalate),
+                _calls34,
+                lambda S, T, lat, idv: (S, T, lat, idv),
+                S, T, lat, idv,
+            )
+
+            if _cut == "c34":
+                return _early_return(S, T, lat, idv)
+
+            # ============= G. Anti-entropy (kaboodle.rs:707-740) ==============
+            # On ticks with no join and no escalation, nothing touched the
+            # state between mark1 and here except mark2, so fp_g is the exact
+            # delta chain; the join-gossip / calls-3-4 branches fall back to a
+            # full recompute (they flip memberships with their own masks).
+            S_g, idv_g = S, idv
+            fp_g, n_g = jax.lax.cond(
+                any_join | jnp.any(escalate),
+                lambda: fp_count(S_g, idv_g),
+                lambda: (fp1 + dfp2, n1 + dn2),
+            )
+
+            # Candidate priority = phase_base + sender index; first match wins
+            # (take_sync_request scans in arrival order). Match condition:
+            # their_fp != our_fp and our_n <= their_n (kaboodle.rs:717-726).
+            prio0, peer0, prio1, peer1 = _ae_phase01(
+                fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt
+            )
+
+            # Phase 2 (call-3 acks): suspect acks at proxies (sender = suspect)
+            # and coincidence forwards at suspectors (sender = pinger-proxy).
+            base2 = jnp.int32(2 * n)
+            x_fp2 = fp2[jnp.clip(jstar, 0)]  # [N] suspect's fp2 per suspector row
+            x_n2 = n2[jnp.clip(jstar, 0)]
+            # at proxy P: candidate (X, fp2[X], n2[X]) — scatter-min over edges.
+            m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
+                n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
+            )
+            prio_proxy = jnp.full((n,), INF).at[jnp.clip(proxies, 0)].min(
+                jnp.where(m_px, base2 + jstar[:, None], INF)
+            )
+            peer_proxy = prio_proxy - base2  # sender == X == candidate peer
+            # at suspector s: candidate (X, fp1[X], n1[X]) via coincidence forward.
+            x_fp1 = fp1[jnp.clip(jstar, 0)]
+            x_n1 = n1[jnp.clip(jstar, 0)]
+            m_cf = del_fwd_c & (x_fp1[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n1[:, None])
+            prio_coinc = jnp.min(jnp.where(m_cf, base2 + proxies, INF), axis=-1)
+            prio2 = jnp.minimum(prio_proxy, prio_coinc)
+            peer2 = jnp.where(prio_proxy <= prio_coinc, peer_proxy, jstar)
+
+            # Phase 3 (call-4 forwarded acks): candidate (X, fp2[X], n2[X]),
+            # sender = forwarding proxy.
+            base3 = jnp.int32(3 * n)
+            m_f = del_fwd & (x_fp2[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n2[:, None])
+            prio3 = jnp.min(jnp.where(m_f, base3 + proxies, INF), axis=-1)
+            peer3 = jstar
+
+            best = jnp.minimum(jnp.minimum(prio0, prio1), jnp.minimum(prio2, prio3))
+            partner = jnp.where(
+                best == prio0,
+                peer0,
+                jnp.where(best == prio1, peer1, jnp.where(best == prio2, peer2, peer3)),
+            ).astype(jnp.int32)
+            has_req = (best != INF) & alive
+            partner = jnp.where(has_req, partner, -1)
+
+            # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
+            del_kpr = has_req & ok_edge(idx, partner)
+            del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
+
+            if _cut == "G":
+                return _early_return(S, T, lat, idv)
+
+            S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+            )
+
+            msgs = (
+                jnp.sum(ok_ping, dtype=jnp.int32)
+                + jnp.sum(ok_man, dtype=jnp.int32)
+                + jnp.sum(del_pr, dtype=jnp.int32)
+                + jnp.sum(del_ack, dtype=jnp.int32)
+                + jnp.sum(del_ack_man, dtype=jnp.int32)
+                + jnp.sum(del_pping, dtype=jnp.int32)
+                + jnp.sum(reply_del, dtype=jnp.int32)
+                + jnp.sum(del_pack, dtype=jnp.int32)
+                + jnp.sum(del_fwd_c, dtype=jnp.int32)
+                + jnp.sum(del_fwd, dtype=jnp.int32)
+                + jnp.sum(del_kpr, dtype=jnp.int32)
+                + jnp.sum(del_rep, dtype=jnp.int32)
+            )
+            return _finish(
+                S, T, lat, idv, jnp.where(del_kpr, partner, -1),
+                fp_g, n_g, fp_f, n_f, msgs,
+            )
+
+        def _fast(S=S, T=T, lat=lat, idv=idv):
+            """Lean tick for fault-free ticks with no Join broadcast and no
+            suspicion activity (the dispatch pred below is False).
+
+            On these ticks A2 is a no-op, there are no proxies, no join
+            replies, no gossip inserts, and calls 3-4 carry nothing — the
+            surviving traffic is the A3 ping, manual pings, their call-2
+            acks, and the anti-entropy exchange, all with masks derived from
+            O(N) vectors (one-hot compares). With no cond boundary between
+            the reads and the writes, XLA fuses the whole update into one
+            composed write chain over (S, T): the tick's [N, N] traffic is
+            the phase-A stats read (above), the eligibility/draw read, and
+            this one read+write — vs ~9 materialized sweeps through the full
+            path (the round-4 on-TPU decomposition, PERF.md). Bit-exact with
+            ``_rest`` on every tick where the pred is False
+            (tests/test_fast_path.py fuzzes the equivalence)."""
+            # A3 on the unchanged state (A2 was a no-op this tick).
+            elig = alive[:, None] & (S == KNOWN) & ~eye
+            ping_tgt = choose_one_of_oldest_k(
+                T, elig, cfg.num_candidate_target_peers, key_ping, det,
+                method=cfg.oldest_k_method,
+            )
+            has_ping = ping_tgt >= 0
+
+            # All of this tick's O(N) delivery plumbing, before any [N, N]
+            # write exists.
+            ok_ping = has_ping & ok_edge(idx, ping_tgt)
+            ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
+            del_ack = ok_ping & ok_edge(ping_tgt, idx)
+            del_ack_man = ok_man & ok_edge(man_tgt, idx)
+
+            # Composed single-pass update. The sequential semantics are
+            # A3 write -> call-1 marks (+deltas) -> call-2 marks (+deltas),
+            # which the full path expresses as three separate write passes;
+            # here every mask is a one-hot outer form over the vectors above,
+            # so the final cell value and both waves' exact (fp, count)
+            # deltas are pure elementwise functions of the ORIGINAL (S, T)
+            # plus those vectors — one read, one write, sibling reductions,
+            # no intermediate [N, N] tensor for XLA to materialize.
+            # Equivalences used (all pinned by tests/test_fast_path.py):
+            #   - A3 changes neither membership (KNOWN -> WaitingForPing,
+            #     both members) nor identity words, so fp0/n0 read the
+            #     original S exactly as the full path's post-A3 fp_count;
+            #   - wave-1/wave-2 overlap (mutual pings: cell (i, j) marked by
+            #     j's ping in wave 1 and j's ack in wave 2) resolves by
+            #     membership-after-wave-1 = member0 | mark1, matching the
+            #     chained apply_marks_delta;
+            #   - marks write (KNOWN, now, sender's current identity) in both
+            #     waves, so last-writer composition is order-free.
+            tgt_cell = _row_mark(idx, ping_tgt, has_ping)
+            mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
+            mark2 = _row_mark(idx, ping_tgt, del_ack) | _row_mark(idx, man_tgt, del_ack_man)
+            markK = mark1 | mark2
+
+            member0 = S > 0
+            n0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
+            member1 = member0 | mark1
+            dn1 = jnp.sum(mark1 & ~member0, axis=-1, dtype=jnp.int32)
+            dn2 = jnp.sum(mark2 & ~member1, axis=-1, dtype=jnp.int32)
+            if has_idv:
+                old_hash = jnp.where(
+                    member0, peer_record_hash(u_row, idv), jnp.uint32(0)
+                )
+                fp0 = jnp.sum(old_hash, axis=-1, dtype=jnp.uint32)
+                dfp1 = jnp.sum(
+                    jnp.where(mark1, rec_hash[None, :] - old_hash, jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                hash1 = jnp.where(mark1, rec_hash[None, :], old_hash)
+                dfp2 = jnp.sum(
+                    jnp.where(mark2, rec_hash[None, :] - hash1, jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                idv = jnp.where(markK, id_row, idv)
+            else:
+                fp0 = jnp.sum(
+                    jnp.where(member0, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                dfp1 = jnp.sum(
+                    jnp.where(mark1 & ~member0, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                dfp2 = jnp.sum(
+                    jnp.where(mark2 & ~member1, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
             if has_lat:
-                lat = jnp.where(fail_del, jnp.nan, lat)
-
-        # Join responses (kaboodle.rs:333-392): r replies to each *new* joiner
-        # with probability max(1, 100-n^2)% where n tracks the sequentially
-        # growing map (cumulative inserts in origin order — exact parity), and
-        # the accepted replies union into a gossip share at the joiner.
-        # The whole block — [N, N] cumsums, the Bernoulli draw, and the two
-        # boolean matmuls — is gated on a join actually happening this tick
-        # (steady-state ticks have none); the skip branch's all-False outputs
-        # are exactly what the formulas produce with join_b all-False. With
-        # broadcasts compiled out there is never a join, so the gate is static.
-        any_join = jnp.any(join_b) if cfg.join_broadcast_enabled else jnp.bool_(False)
-
-        def _join_replies():
-            n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
-            reply_p = broadcast_reply_prob(n_after)
-            bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
-            reply = is_new_ro & bern  # [r, o]
-            reply_del_ = reply & ok_outer()  # response unicast r -> o gated like any message
-
-            # Gossip union at joiner o (deliverable in call 2): the reply share
-            # is r's map at reply time = start-of-round map + joiners accepted
-            # with origin index <= o (the oracle's sequential processing order):
-            #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
-            share_base = member_a
-            if cfg.max_share_peers and n > cfg.max_share_peers:
-                # D5: cap to lowest-index members of the start-of-round map.
-                within_cap = (
-                    jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+                # Wave-ordered EWMA sampling, composed: wave 1 samples where
+                # the post-A3 state was waiting; wave 2 where the post-wave-1
+                # state still was (a wave-1 mark clears it to Known).
+                S_a3 = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
+                T_a3 = jnp.where(tgt_cell, tT, T)
+                waiting1 = (S_a3 == WAITING_FOR_PING) | (
+                    S_a3 == WAITING_FOR_INDIRECT_PING
                 )
-                share_base = member_a & within_cap
-            term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
-            term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
-            tri = idx[None, :] <= idx[:, None]  # j <= o
-            return reply_del_, term1 | (term2 & tri)
-
-        if cfg.join_broadcast_enabled:
-            reply_del, gossip = jax.lax.cond(
-                any_join,
-                _join_replies,
-                lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
-            )
-        else:
-            reply_del = gossip = jnp.zeros((n, n), dtype=bool)
-
-        # ================= Call 1: Pings + PingRequests =======================
-        ok_ping = has_ping & ok_edge(idx, ping_tgt)
-        ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
-        del_pr = proxies_valid & ok_edge(idx[:, None], proxies)  # [N, k]
-
-        # mark1[dest, sender]: dense one-hot compares (no scatter) — each term
-        # fuses into apply_marks' where pass. The proxy terms are all-False on
-        # escalation-free ticks but cost only fused compares, not a gather.
-        mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
-        for kk in range(proxies.shape[-1]):
-            mark1 |= _col_mark(idx, proxies[:, kk], del_pr[:, kk])
-        # Base fingerprint once (post-A3: the A3 WaitingForPing write moves no
-        # membership and no identity word, so this equals the pre-mark1 fp);
-        # every later fp point derives by exact per-wave deltas on the fast
-        # path, with full recomputes only inside the join/escalation branches.
-        fp0, n0 = fp_count(S, idv)
-        S, T, lat, idv, dfp1, dn1 = apply_marks_delta(S, T, lat, idv, mark1)
-        fp1, n1 = fp0 + dfp1, n0 + dn1
-
-        if _cut == "c1":
-            return _early_return(S, T, lat, idv)
-
-        # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and the
-        # proxies' Pings to the suspect (kaboodle.rs:533-545).
-        del_ack = ok_ping & ok_edge(ping_tgt, idx)  # tgt -> pinger
-        del_ack_man = ok_man & ok_edge(man_tgt, idx)
-        ok_p2x = ok_edge(proxies, jstar[:, None])  # proxy -> suspect
-        del_pping = del_pr & ok_p2x  # [N, k]
-
-        # ================= Call 2: Acks, proxy Pings, join responses ==========
-        mark2 = _row_mark(idx, ping_tgt, del_ack)  # pinger marks target
-        mark2 |= _row_mark(idx, man_tgt, del_ack_man)
-        mark2 |= reply_del.T  # joiner marks join-responder
-        # Suspect-marks-proxy scatters on BOTH dims (jstar rows x proxy cols),
-        # so it has no one-hot form; it is escalation-only, so gate the
-        # scatter out of steady-state ticks.
-        mark2 |= jax.lax.cond(
-            jnp.any(escalate),
-            lambda: _scatter_or(
-                jnp.zeros((n, n), dtype=bool),
-                jnp.broadcast_to(jstar[:, None], proxies.shape),
-                proxies,
-                del_pping,
-            ),
-            lambda: jnp.zeros((n, n), dtype=bool),
-        )
-        S, T, lat, idv, dfp2, dn2 = apply_marks_delta(S, T, lat, idv, mark2)
-
-        # Gossip-learned peers insert back-dated (Q6) where still unknown, with
-        # identity words resolved to the peers' current identities (deviation
-        # D-ID1 — shared with the lockstep oracle; the native engine carries
-        # the sharer's view faithfully).
-        if cfg.join_broadcast_enabled:
-
-            def _gossip_insert(S, T, idv):
-                gossip_new = gossip & ~(S > 0)
-                S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
-                T = jnp.where(gossip_new, tT - gossip_backdate, T)
-                if has_idv:
-                    idv = jnp.where(gossip_new, id_row, idv)
-                return S, T, idv
-
-            S, T, idv = jax.lax.cond(
-                any_join, _gossip_insert, lambda S, T, idv: (S, T, idv), S, T, idv
-            )
-
-        # fp2/n2 feed only the indirect-ping ack payloads (call-3 acks at
-        # proxies, call-4 forwards) — every consumer is masked by an
-        # escalation-derived delivery, so the whole O(N^2) hash pass is gated
-        # off on escalation-free ticks (all of fault-free steady state).
-        S_2 = S
-        fp2, n2 = jax.lax.cond(
-            jnp.any(escalate),
-            lambda: fp_count(S_2, idv),
-            lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
-        )
-
-        if _cut == "c2":
-            return _early_return(S, T, lat, idv)
-
-        # Queued: the suspect's Acks back to the proxies.
-        del_pack = del_pping & ok_edge(jstar[:, None], proxies)  # [N, k]
-
-        # Coincidence forwarding (kaboodle.rs:418-443 pop semantics): if proxy
-        # p's own direct or manual ping this tick targeted the same suspect,
-        # p's call-2 Ack for it pops the curious entry and forwards fp1-payload
-        # Acks in call 3; the call-3 proxy Ack then finds curious empty.
-        p_tgt = ping_tgt[jnp.clip(proxies, 0)]  # [N, k] the proxies' own ping targets
-        p_man = man_tgt[jnp.clip(proxies, 0)]
-        p_got_direct = del_ack[jnp.clip(proxies, 0)]
-        p_got_man = del_ack_man[jnp.clip(proxies, 0)]
-        pop_hit = ((p_tgt == jstar[:, None]) & p_got_direct) | (
-            (p_man == jstar[:, None]) & p_got_man
-        )
-        fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
-        del_fwd_c = fwd_c & ok_edge(proxies, idx[:, None])  # p -> suspector
-
-        # Proxy forwards the suspect's Ack (fp2 payload) in call 4 unless the
-        # curious entry was already popped by the call-2 coincidence.
-        fwd = del_pack & ~pop_hit
-        del_fwd = fwd & ok_edge(proxies, idx[:, None])  # [N, k] p -> suspector
-
-        # ============ Calls 3 + 4: escalation-only delivery waves =============
-        # Call 3: suspect Acks at proxies; call 4: forwarded Acks. Every
-        # datagram in these waves descends from an escalation this tick, so
-        # the mark scatters and full-matrix where-passes are gated out of
-        # escalation-free ticks (all of fault-free steady state).
-        def _calls34(S, T, lat, idv):
-            mark3 = jnp.zeros((n, n), dtype=bool)
-            mark3 = _scatter_or(
-                mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
-            )  # proxy marks suspect — the proxy's own view resurrects (Q1)
-            mark3 = _scatter_or(
-                mark3, idx[:, None], proxies, del_fwd_c
-            )  # suspector marks pinger-proxy
-            S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
-
-            # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is the
-            # proxy, so the suspector marks the proxy — the suspect stays
-            # WaitingForIndirectPing (kaboodle.rs:408-415 applies to the sender).
-            mark4 = jnp.zeros((n, n), dtype=bool)
-            mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
-            S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
-            if not cfg.faithful_indirect_ack:
-                # Intended-SWIM mode: a forwarded ack clears the suspect too.
-                cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
-                clr_cell = cleared[:, None] & jstar_cell & (S > 0)
-                S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
-                T = jnp.where(clr_cell, tT, T)
-            return S, T, lat, idv
-
-        S, T, lat, idv = jax.lax.cond(
-            jnp.any(escalate),
-            _calls34,
-            lambda S, T, lat, idv: (S, T, lat, idv),
-            S, T, lat, idv,
-        )
-
-        if _cut == "c34":
-            return _early_return(S, T, lat, idv)
-
-        # ================= G. Anti-entropy (kaboodle.rs:707-740) ==============
-        # On ticks with no join and no escalation, nothing touched the state
-        # between mark1 and here except mark2, so fp_g is the exact delta
-        # chain; the join-gossip / calls-3-4 branches fall back to a full
-        # recompute (they flip memberships with their own masks).
-        S_g, idv_g = S, idv
-        fp_g, n_g = jax.lax.cond(
-            any_join | jnp.any(escalate),
-            lambda: fp_count(S_g, idv_g),
-            lambda: (fp1 + dfp2, n1 + dn2),
-        )
-
-        # Candidate priority = phase_base + sender index; first match wins
-        # (take_sync_request scans in arrival order). Match condition:
-        # their_fp != our_fp and our_n <= their_n (kaboodle.rs:717-726).
-        INF = jnp.int32(_I32MAX)
-
-        # Phase 0: last tick's KnownPeersRequest senders (first in the list —
-        # their candidates were recorded before this tick's acks arrived).
-        m0 = (st.kpr_partner[None, :] == idx[:, None]) & alive[:, None] & ~rv[:, None]
-        match0 = m0 & (st.kpr_fp[None, :] != fp_g[:, None]) & (n_g[:, None] <= st.kpr_n[None, :])
-        prio0 = jnp.min(jnp.where(match0, idx[None, :], INF), axis=-1)
-        peer0 = prio0  # sender == candidate peer for KPR candidates
-
-        # Phase 1 (call-2 acks): direct + manual, sender == acked peer.
-        base1 = jnp.int32(n)
-        m_d = del_ack & (fp1[jnp.clip(ping_tgt, 0)] != fp_g) & (n_g <= n1[jnp.clip(ping_tgt, 0)])
-        m_m = del_ack_man & (fp1[jnp.clip(man_tgt, 0)] != fp_g) & (n_g <= n1[jnp.clip(man_tgt, 0)])
-        prio_d = jnp.where(m_d, base1 + ping_tgt, INF)
-        prio_m = jnp.where(m_m, base1 + man_tgt, INF)
-        prio1 = jnp.minimum(prio_d, prio_m)
-        peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
-
-        # Phase 2 (call-3 acks): suspect acks at proxies (sender = suspect)
-        # and coincidence forwards at suspectors (sender = pinger-proxy).
-        base2 = jnp.int32(2 * n)
-        x_fp2 = fp2[jnp.clip(jstar, 0)]  # [N] suspect's fp2 per suspector row
-        x_n2 = n2[jnp.clip(jstar, 0)]
-        # at proxy P: candidate (X, fp2[X], n2[X]) — scatter-min over edges.
-        m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
-            n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
-        )
-        prio_proxy = jnp.full((n,), INF).at[jnp.clip(proxies, 0)].min(
-            jnp.where(m_px, base2 + jstar[:, None], INF)
-        )
-        peer_proxy = prio_proxy - base2  # sender == X == candidate peer
-        # at suspector s: candidate (X, fp1[X], n1[X]) via coincidence forward.
-        x_fp1 = fp1[jnp.clip(jstar, 0)]
-        x_n1 = n1[jnp.clip(jstar, 0)]
-        m_cf = del_fwd_c & (x_fp1[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n1[:, None])
-        prio_coinc = jnp.min(jnp.where(m_cf, base2 + proxies, INF), axis=-1)
-        prio2 = jnp.minimum(prio_proxy, prio_coinc)
-        peer2 = jnp.where(prio_proxy <= prio_coinc, peer_proxy, jstar)
-
-        # Phase 3 (call-4 forwarded acks): candidate (X, fp2[X], n2[X]),
-        # sender = forwarding proxy.
-        base3 = jnp.int32(3 * n)
-        m_f = del_fwd & (x_fp2[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n2[:, None])
-        prio3 = jnp.min(jnp.where(m_f, base3 + proxies, INF), axis=-1)
-        peer3 = jstar
-
-        best = jnp.minimum(jnp.minimum(prio0, prio1), jnp.minimum(prio2, prio3))
-        partner = jnp.where(
-            best == prio0,
-            peer0,
-            jnp.where(best == prio1, peer1, jnp.where(best == prio2, peer2, peer3)),
-        ).astype(jnp.int32)
-        has_req = (best != INF) & alive
-        partner = jnp.where(has_req, partner, -1)
-
-        # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
-        del_kpr = has_req & ok_edge(idx, partner)
-        del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
-
-        if _cut == "G":
-            return _early_return(S, T, lat, idv)
-
-        def _g_apply(S, T, lat, idv):
-            mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
-            S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
-
-            # Filtered reply share (kaboodle.rs:483-501): Known peers heard
-            # from strictly within MAX_PEER_SHARE_AGE, excluding self (and the
-            # requester — enforced receiver-side as j != i, same effect).
-            # Computed post-marks, matching the oracle's two-pass delivery.
-            # Not capped (Q12). The share snapshot is taken before the
-            # requester-marks-partner write below (the oracle's two-pass
-            # order): a partner's own fresh call-G marks must not leak into
-            # the rows it shares this tick.
-            S_share, T_share = S, T
-            mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
-            S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
-            T = jnp.where(mark_rep, tT, T)
-
-            def _kpr_reply_insert(S, T, idv):
-                share_f = (S_share == KNOWN) & ~eye & (
-                    (t - T_share) < cfg.max_peer_share_age_ticks
+                sample1 = (t - T_a3).astype(jnp.float32)
+                upd1 = jnp.where(
+                    jnp.isnan(lat), sample1,
+                    jnp.float32(0.8) * sample1 + jnp.float32(0.2) * lat,
                 )
-                srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
-                rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
-                S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
-                T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
-                if has_idv:
-                    # The reply carries (addr, identity) records
-                    # (structs.rs:110); identity words resolve to the peers'
-                    # current identities (D-ID1, like the join-gossip insert
-                    # above). Without this, a row re-filled after a revive
-                    # keeps placeholder words and its fingerprint can never
-                    # agree.
-                    idv = jnp.where(rep_ins, id_row, idv)
-                return S2, T2, idv
-
-            S, T, idv = jax.lax.cond(
-                jnp.any(del_rep),
-                _kpr_reply_insert,
-                lambda S, T, idv: (S, T, idv),
-                S, T, idv,
+                lat1 = jnp.where(mark1 & waiting1, upd1, lat)
+                S_1 = jnp.where(mark1, jnp.int8(KNOWN), S_a3)
+                T_1 = jnp.where(mark1, tT, T_a3)
+                waiting2 = (S_1 == WAITING_FOR_PING) | (
+                    S_1 == WAITING_FOR_INDIRECT_PING
+                )
+                sample2 = (t - T_1).astype(jnp.float32)
+                upd2 = jnp.where(
+                    jnp.isnan(lat1), sample2,
+                    jnp.float32(0.8) * sample2 + jnp.float32(0.2) * lat1,
+                )
+                lat = jnp.where(mark2 & waiting2, upd2, lat1)
+            S = jnp.where(
+                markK, jnp.int8(KNOWN),
+                jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S),
             )
-            fp_f, n_f = fp_count(S, idv)
-            return S, T, lat, idv, fp_f, n_f
+            T = jnp.where(markK | tgt_cell, tT, T)
+            fp1, n1 = fp0 + dfp1, n0 + dn1
+            fp_g, n_g = fp1 + dfp2, n1 + dn2
 
-        # Requests only flow while fingerprints disagree, so every call-G
-        # [N, N] pass — the marks, the share gather/insert, and the final
-        # fingerprint read — is gated on a request actually being delivered:
-        # on a converged steady-state tick nothing below here touches the
-        # state and fp_f is exactly fp_g.
-        S, T, lat, idv, fp_f, n_f = jax.lax.cond(
-            jnp.any(del_kpr),
-            _g_apply,
-            lambda S, T, lat, idv: (S, T, lat, idv, fp_g, n_g),
-            S, T, lat, idv,
-        )
+            # G: anti-entropy candidates — phases 0 and 1 only (phases 2-3
+            # are escalation-borne and there is none). The phase-2/3
+            # priorities are INF in _rest on these ticks, so the minimum and
+            # the selected partner agree exactly.
+            prio0, peer0, prio1, peer1 = _ae_phase01(
+                fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt
+            )
 
-        # ================= metrics + next state ===============================
-        fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
-        fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
-        n_alive = jnp.sum(alive, dtype=jnp.int32)
-        converged = (fpa_min == fpa_max) & (n_alive > 0)
-        agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
+            best = jnp.minimum(prio0, prio1)
+            partner = jnp.where(best == prio0, peer0, peer1).astype(jnp.int32)
+            has_req = (best != INF) & alive
+            partner = jnp.where(has_req, partner, -1)
+            del_kpr = has_req & ok_edge(idx, partner)
+            del_rep = del_kpr & ok_edge(partner, idx)
 
-        msgs = (
-            jnp.sum(ok_ping, dtype=jnp.int32)
-            + jnp.sum(ok_man, dtype=jnp.int32)
-            + jnp.sum(del_pr, dtype=jnp.int32)
-            + jnp.sum(del_ack, dtype=jnp.int32)
-            + jnp.sum(del_ack_man, dtype=jnp.int32)
-            + jnp.sum(del_pping, dtype=jnp.int32)
-            + jnp.sum(reply_del, dtype=jnp.int32)
-            + jnp.sum(del_pack, dtype=jnp.int32)
-            + jnp.sum(del_fwd_c, dtype=jnp.int32)
-            + jnp.sum(del_fwd, dtype=jnp.int32)
-            + jnp.sum(del_kpr, dtype=jnp.int32)
-            + jnp.sum(del_rep, dtype=jnp.int32)
-        )
+            S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+            )
+            msgs = (
+                jnp.sum(ok_ping, dtype=jnp.int32)
+                + jnp.sum(ok_man, dtype=jnp.int32)
+                + jnp.sum(del_ack, dtype=jnp.int32)
+                + jnp.sum(del_ack_man, dtype=jnp.int32)
+                + jnp.sum(del_kpr, dtype=jnp.int32)
+                + jnp.sum(del_rep, dtype=jnp.int32)
+            )
+            return _finish(
+                S, T, lat, idv, jnp.where(del_kpr, partner, -1),
+                fp_g, n_g, fp_f, n_f, msgs,
+            )
 
-        new_state = MeshState(
-            state=S,
-            timer=T,
-            alive=alive,
-            identity=st.identity,
-            never_broadcast=never_b,
-            last_broadcast=last_b,
-            kpr_partner=jnp.where(del_kpr, partner, -1),
-            kpr_fp=fp_g,
-            kpr_n=n_g,
-            tick=t + 1,
-            key=key_next,
-            latency=lat,
-            id_view=idv,
-        )
-        metrics = TickMetrics(
-            messages_delivered=msgs,
-            converged=converged,
-            agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
-            mean_membership=jnp.sum(jnp.where(alive, n_f, 0)).astype(jnp.float32)
-            / jnp.maximum(n_alive, 1),
-            fingerprint_min=fpa_min,
-            fingerprint_max=fpa_max,
-        )
-        return new_state, metrics
+        # ---- dispatch ---------------------------------------------------------
+        # The faulty build keeps the single full path (fault scenarios hit the
+        # gated branches constantly, and the ok-matrix plumbing differs); the
+        # fault-free build selects per tick. _cut probes always time the full
+        # path.
+        use_fast = cfg.fast_path and not faulty and _cut is None
+        if not use_fast:
+            return _rest()
+        return jax.lax.cond(any_a2 | any_join, _rest, _fast)
 
     return tick

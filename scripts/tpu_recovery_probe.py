@@ -30,7 +30,7 @@ CHUNK = 256
 
 def _calm_until_converged(st, cfg, n, budget):
     """Fault-free calm ticks in CHUNK-sized scans until every survivor
-    agrees. Returns (ticks_used_or_None, converged)."""
+    agrees. Returns (final_state, ticks_used_or_None, converged)."""
     import jax
     import numpy as np
 

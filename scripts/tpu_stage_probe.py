@@ -164,7 +164,9 @@ def probe(n):
                      ("iter", dict(use_pallas_fp=True,
                                    oldest_k_method="iter")),
                      ("nopallas", dict())):
-        cfg = SwimConfig(**kw)
+        # fast_path=False keeps these keys comparable with the r4 captures
+        # (full-path timings); the fast/slow A/B lives in tpu_watch.MEASURE.
+        cfg = SwimConfig(fast_path=False, **kw)
 
         @jax.jit
         def run(s, i, cfg=cfg):
@@ -240,7 +242,11 @@ def probe_cuts(n, variant="fused_all"):
                           use_pallas_suspicion=True),
         "jnp": dict(),
     }[variant]
-    cfg = SwimConfig(**kw)
+    # The cuts truncate the FULL path and the dispatch pred is always False
+    # on this converged state, so the _cut=None datapoint must pin
+    # fast_path=False too — otherwise it times the lean branch and the
+    # successive-diff decomposition is meaningless.
+    cfg = SwimConfig(fast_path=False, **kw)
     st = init_state(n, seed=0, ring_contacts=n - 1, track_latency=False,
                     instant_identity=True, timer_dtype=jnp.int16)
     idle = idle_inputs(n)

@@ -33,8 +33,8 @@ MEASURE_TIMEOUT_S = 5400
 POLL_INTERVAL_S = 240
 
 MEASURE = r"""
-import json, time, functools
-import numpy as np, jax, jax.numpy as jnp
+import json, time
+import jax, jax.numpy as jnp
 
 out = {"ts": time.time(), "kind": "measure"}
 
@@ -61,41 +61,30 @@ def fetch_timeit(f, *a, reps=3):
     float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
     return (time.perf_counter() - t0) / reps
 
-n = 16384
-rng = np.random.default_rng(0)
-
 # ---- 1. Whole-tick A/B FIRST, most valuable variant first ------------------
 # The wedge pattern (TPU_BENCH_NOTES.md) is that a long compile can close the
 # window mid-measure; every metric already banked is kept via WATCHPART, so
-# order strictly by value: the post-rewrite fused_all tick at N=16,384 is THE
-# round-4 headline (VERDICT item 1), then the ablation variants, then the
-# component microbench, then the N=32,768 ceiling.
+# order strictly by value: the round-4b composed fast path vs the full path
+# at N=16,384 (converged steady state — the headline workload), then the
+# fused-stats ablation, then the N=32,768 ceiling.
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.sim.runner import simulate
 from kaboodle_tpu.sim.state import idle_inputs, init_state
 
-variants = {}
-try:
-    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
-    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion  # noqa: F401
-    variants["fused_all"] = dict(
-        use_pallas_fp=True, use_pallas_oldest_k=True, use_pallas_suspicion=True
-    )
-except ImportError:
-    pass
-try:
-    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k  # noqa: F401
-    variants["fusedk"] = dict(use_pallas_fp=True, use_pallas_oldest_k=True)
-except ImportError:
-    pass
-variants["iter"] = dict(use_pallas_fp=True, oldest_k_method="iter")
-variants["topk"] = dict(use_pallas_fp=True, oldest_k_method="topk")
-variants["nopallas"] = dict()
+variants = {
+    # The composed fast path (kernel.py _fast): defaults.
+    "fast": dict(),
+    # Fast dispatch + the Pallas phase-A stats pass feeding it.
+    "fast_fsusp": dict(use_pallas_suspicion=True),
+    # The r4-banked configuration: single full path, all stage kernels.
+    "slow_fused": dict(fast_path=False, use_pallas_fp=True,
+                       use_pallas_oldest_k=True, use_pallas_suspicion=True),
+    # Single full path, pure jnp (the r4 'nopallas' ablation).
+    "slow_jnp": dict(fast_path=False),
+}
 
-def tick_ab(tick_n):
-    st = init_state(tick_n, seed=0, track_latency=False, instant_identity=True,
-                    timer_dtype=jnp.int16)
-    inp = idle_inputs(tick_n, ticks=8)
+def tick_ab(tick_n, ticks=32):
+    inp = idle_inputs(tick_n, ticks=ticks)
     suffix = "" if tick_n == 16384 else f"_n{tick_n}"
     for name, kw in variants.items():
         try:
@@ -104,8 +93,16 @@ def tick_ab(tick_n):
             def run(s, i, cfg=cfg):
                 o, _ = simulate(s, i, cfg, faulty=False)
                 return o.timer.sum() + o.tick
-            sec = fetch_timeit(run, st, inp, reps=2)
-            out[f"tick_{name}{suffix}_ms"] = sec / 8 * 1e3
+            for ring, label in ((tick_n - 1, ""), (0, "_selfonly")):
+                # Converged steady state first (the headline workload);
+                # the self-only boot state for continuity with r4 numbers.
+                if name in ("fast_fsusp", "slow_jnp") and ring == 0:
+                    continue  # ablations only need the headline state
+                st = init_state(tick_n, seed=0, ring_contacts=ring,
+                                track_latency=False, instant_identity=True,
+                                timer_dtype=jnp.int16)
+                sec = fetch_timeit(run, st, inp, reps=2)
+                out[f"tick_{name}{label}{suffix}_ms"] = sec / ticks * 1e3
         except Exception as e:
             out[f"tick_{name}{suffix}_error"] = repr(e)[:300]
     try:
@@ -116,48 +113,9 @@ def tick_ab(tick_n):
 
 tick_ab(16384)
 
-# ---- 2. Component microbench at N=16,384 -----------------------------------
-S = jnp.asarray(rng.integers(0, 3, (n, n)), jnp.int8)
-T = jnp.asarray(rng.integers(0, 100, (n, n)), jnp.int16)
-rh = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
-elig = S == 1
-key = jax.random.PRNGKey(0)
-
-from kaboodle_tpu.ops.fused_fp import fused_fp_count
-from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
-out["fused_fp_ms"] = fetch_timeit(functools.partial(fused_fp_count, S, rh)) * 1e3
-
-@jax.jit
-def jnp_fp(S, rh):
-    m = S > 0
-    return jnp.sum(jnp.where(m, rh[None, :], jnp.uint32(0)), axis=-1, dtype=jnp.uint32)
-out["jnp_fp_ms"] = fetch_timeit(jnp_fp, S, rh) * 1e3
-
-for method in ("topk", "iter"):
-    f = jax.jit(functools.partial(
-        choose_one_of_oldest_k, k=5, deterministic=False, method=method))
-    out[f"oldest5_{method}_ms"] = fetch_timeit(
-        lambda: f(timer=T, eligible=elig, key=key)) * 1e3
-
-# S must be an argument, not a closure capture: captured arrays embed as
-# jaxpr constants in the remote-compile request, and 256 MiB bodies get
-# HTTP 413 from the tunnel endpoint.
-@jax.jit
-def scatter_mark(S, tgt, val):
-    m = jnp.zeros((n, n), dtype=bool).at[jnp.clip(tgt, 0), jnp.arange(n)].max(val)
-    return jnp.where(m, jnp.int8(1), S).sum(dtype=jnp.int32)
-
-@jax.jit
-def onehot_mark(S, tgt, val):
-    idx = jnp.arange(n, dtype=jnp.int32)
-    m = (idx[:, None] == tgt[None, :]) & val[None, :]
-    return jnp.where(m, jnp.int8(1), S).sum(dtype=jnp.int32)
-
-tgt = jnp.asarray(rng.integers(0, n, n, dtype=np.int32))
-val = jnp.ones((n,), bool)
-out["scatter_mark_ms"] = fetch_timeit(scatter_mark, S, tgt, val) * 1e3
-out["onehot_mark_ms"] = fetch_timeit(onehot_mark, S, tgt, val) * 1e3
-del S, T, rh, elig, tgt, val
+# (The single-dispatch component microbench that used to sit here is
+# superseded by the scan-amortized scripts/tpu_stage_probe.py — its numbers
+# were dispatch-floor bound; the banked captures remain in TPU_WATCH.log.)
 
 # ---- 3. The single-chip ceiling size last ----------------------------------
 tick_ab(32768)

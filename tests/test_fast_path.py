@@ -1,0 +1,110 @@
+"""Fast-path/full-path equivalence for the fault-free tick.
+
+``SwimConfig.fast_path`` compiles the fault-free tick as a two-branch
+``lax.cond`` (kernel.py dispatch): a lean path for ticks with no Join
+broadcast and no suspicion activity, the full path for everything else.
+The contract is BIT-EXACT equality with the single-path build
+(``fast_path=False``) on every trajectory — the dispatch pred must route
+every tick with surviving full-path-only traffic to the full path, and the
+lean path must reproduce the full path's semantics exactly on the rest.
+
+These tests fuzz that contract over boot modes, dtypes, optional state
+planes (latency, id_view), deterministic/random draws, and manual pings —
+multi-tick trajectories so mid-boot unconverged states, rebroadcast ticks,
+and converged steady ticks all appear.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.runner import simulate
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+
+def _trajectories_equal(st, inp, cfg):
+    fast = jax.jit(lambda s, i: simulate(s, i, cfg, faulty=False))
+    slow_cfg = dataclasses.replace(cfg, fast_path=False)
+    slow = jax.jit(lambda s, i: simulate(s, i, slow_cfg, faulty=False))
+    out_f, m_f = fast(st, inp)
+    out_s, m_s = slow(st, inp)
+    for a, b in zip(jax.tree.leaves((out_f, m_f)), jax.tree.leaves((out_s, m_s))):
+        av, bv = np.asarray(a), np.asarray(b)
+        if av.dtype == np.float32:  # latency plane carries NaNs (no sample)
+            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all()
+        else:
+            assert (av == bv).all(), (av != bv).sum()
+    return m_f
+
+
+@pytest.mark.parametrize("det", [True, False])
+@pytest.mark.parametrize("ring", [0, 2, 63])
+def test_fast_path_matches_full_over_boot(det, ring):
+    """Broadcast boot (ring=0: join avalanche tick), epidemic-ish partial
+    mesh (ring=2), and converged-init (ring=63) trajectories, 24 ticks:
+    covers join ticks (full path), unconverged anti-entropy ticks, and
+    converged steady ticks (lean path)."""
+    n = 64
+    cfg = SwimConfig(deterministic=det)
+    st = init_state(n, seed=3, ring_contacts=ring)
+    inp = idle_inputs(n, ticks=24)
+    _trajectories_equal(st, inp, cfg)
+
+
+@pytest.mark.parametrize("timer_dtype", [jnp.int32, jnp.int16])
+@pytest.mark.parametrize("lean", [True, False])
+def test_fast_path_matches_full_state_planes(timer_dtype, lean):
+    """Optional planes: latency EWMA + per-row identity views on, and the
+    lean (instant-identity, no-latency) mode — both must match exactly,
+    including the two-wave latency sampling order inside the composed
+    write chain."""
+    n = 48
+    cfg = SwimConfig()
+    st = init_state(n, seed=9, ring_contacts=n - 1, track_latency=not lean,
+                    instant_identity=lean, timer_dtype=timer_dtype)
+    inp = idle_inputs(n, ticks=16)
+    _trajectories_equal(st, inp, cfg)
+
+
+def test_fast_path_matches_full_manual_pings():
+    """Manual pings (ping_addrs) flow through the lean path's mark1/mark2
+    and the phase-1 anti-entropy candidates; out-of-range and self targets
+    are dropped (D8)."""
+    n = 32
+    cfg = SwimConfig()
+    st = init_state(n, seed=5, ring_contacts=4)
+    rng = np.random.default_rng(0)
+    inp = idle_inputs(n, ticks=12)
+    manual = rng.integers(-1, n + 2, size=(12, n)).astype(np.int32)
+    inp = dataclasses.replace(inp, manual_target=jnp.asarray(manual))
+    _trajectories_equal(st, inp, cfg)
+
+
+def test_fast_path_routes_suspicion_to_full_path():
+    """A trajectory that develops suspicion activity (engineered by aging a
+    WaitingForPing cell past the timeout) still matches the single-path
+    build — i.e. the dispatch pred catches escalation/removal ticks."""
+    n = 32
+    cfg = SwimConfig()
+    st = init_state(n, seed=7, ring_contacts=n - 1)
+    # Age peer 0's view of peer 1 into a timed-out WaitingForPing cell.
+    state = np.asarray(st.state).copy()
+    timer = np.asarray(st.timer).copy()
+    state[0, 1] = 2  # WAITING_FOR_PING
+    timer[0, 1] = -10
+    st = dataclasses.replace(
+        st, state=jnp.asarray(state), timer=jnp.asarray(timer)
+    )
+    inp = idle_inputs(n, ticks=10)
+    m = _trajectories_equal(st, inp, cfg)
+    del m
+
+
+def test_fast_path_default_on():
+    assert SwimConfig().fast_path

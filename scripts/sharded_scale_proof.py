@@ -119,9 +119,18 @@ def main() -> None:
     # ---- phase 1: boot to convergence under GSPMD --------------------------
     if args.boot != "none":
         epidemic = args.boot == "epidemic"
+        # fast_path off on the CPU backend: the two-branch fault-free tick
+        # roughly doubles XLA:CPU's peak buffer allocation (both cond
+        # branches' temporaries), which the memory-bound emulating host
+        # cannot afford — the first N=65,536 retry with the split tick
+        # OOM-killed in THIS boot phase at ~174 GiB where the single-path
+        # build peaked at ~131 GiB (SCALE_PROOF.md attempts 3/5). Non-CPU
+        # backends keep the default (on TPU the split tick is faster and
+        # showed no memory incident).
         boot_cfg = SwimConfig(
             join_broadcast_enabled=not epidemic,
             backdate_gossip_inserts=not epidemic,
+            fast_path=jax.default_backend() != "cpu",
         )
         ring = {"epidemic": 2, "broadcast": 0, "converged": n - 1}[args.boot]
         st0 = shard_state(

@@ -7,6 +7,7 @@ from kaboodle_tpu.parallel.mesh import (
     make_multihost_mesh,
     make_sharded_tick,
     run_until_converged_sharded,
+    sharded_convergence_check,
     shard_inputs,
     shard_state,
     simulate_sharded,
@@ -20,6 +21,7 @@ __all__ = [
     "make_multihost_mesh",
     "make_sharded_tick",
     "run_until_converged_sharded",
+    "sharded_convergence_check",
     "shard_inputs",
     "shard_state",
     "simulate_sharded",

@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -212,6 +213,36 @@ def simulate_sharded(
     """Sharded twin of :func:`kaboodle_tpu.sim.runner.simulate` (lax.scan)."""
     tick = make_sharded_tick(cfg, mesh, faulty=faulty)
     return jax.lax.scan(tick, state, inputs)
+
+
+@jax.jit
+def sharded_convergence_check(state: MeshState):
+    """Fingerprint agreement of a (possibly sharded) mesh WITHOUT a tick.
+
+    Computes each row's fingerprint (the same wraparound uint32 sum of
+    per-member record-hash words the tick kernel reduces at the end of
+    every tick — kernel.py ``fp_count``/``_finish``) and tests min == max
+    over alive rows. Under GSPMD the row reduction stays shard-local and
+    the min/max combine across the peer axis — the BASELINE config-4
+    "ICI all-reduce fingerprint check" as a standalone O(one state read)
+    program. Exists for scales where even a single full tick exceeds the
+    host: the N=65,536 emulated-mesh proof asserts the converged-init
+    state through this (SCALE_PROOF.md), whose peak footprint is the
+    masked-contribution read instead of a whole tick's working set.
+
+    Returns ``(converged, fp_min, fp_max, n_alive)``.
+    """
+    from kaboodle_tpu.ops.hashing import membership_fingerprint
+
+    fp = membership_fingerprint(
+        state.state > 0,
+        state.id_view if state.id_view is not None else state.identity,
+    )
+    alive = state.alive
+    fp_min = jnp.min(jnp.where(alive, fp, jnp.uint32(0xFFFFFFFF)))
+    fp_max = jnp.max(jnp.where(alive, fp, jnp.uint32(0)))
+    n_alive = jnp.sum(alive, dtype=jnp.int32)
+    return (fp_min == fp_max) & (n_alive > 0), fp_min, fp_max, n_alive
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_ticks"))

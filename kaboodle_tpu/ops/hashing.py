@@ -68,3 +68,22 @@ def membership_fingerprint(member: jax.Array, identities: jax.Array) -> jax.Arra
         h = peer_record_hash(pid, identities)
         contrib = jnp.where(member, h[None, :], jnp.uint32(0))
     return jnp.sum(contrib, axis=-1, dtype=jnp.uint32)
+
+
+def fingerprint_agreement(alive, fp):
+    """Global fingerprint-agreement reduction over the alive rows.
+
+    THE convergence predicate of the whole framework — the tick kernel's
+    end-of-tick metrics and the standalone
+    :func:`kaboodle_tpu.parallel.sharded_convergence_check` both reduce
+    through this one helper so the definition cannot drift (the sentinels
+    make dead rows neutral for both extremes). Under GSPMD the min/max/sum
+    partition into per-shard reductions combined over the peer axis — the
+    BASELINE config-4 "ICI all-reduce" check.
+
+    Returns ``(converged, fp_min, fp_max, n_alive)``.
+    """
+    fp_min = jnp.min(jnp.where(alive, fp, jnp.uint32(0xFFFFFFFF)))
+    fp_max = jnp.max(jnp.where(alive, fp, jnp.uint32(0)))
+    n_alive = jnp.sum(alive, dtype=jnp.int32)
+    return (fp_min == fp_max) & (n_alive > 0), fp_min, fp_max, n_alive

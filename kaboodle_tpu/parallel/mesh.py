@@ -30,7 +30,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -232,17 +231,13 @@ def sharded_convergence_check(state: MeshState):
 
     Returns ``(converged, fp_min, fp_max, n_alive)``.
     """
-    from kaboodle_tpu.ops.hashing import membership_fingerprint
+    from kaboodle_tpu.ops.hashing import fingerprint_agreement, membership_fingerprint
 
     fp = membership_fingerprint(
         state.state > 0,
         state.id_view if state.id_view is not None else state.identity,
     )
-    alive = state.alive
-    fp_min = jnp.min(jnp.where(alive, fp, jnp.uint32(0xFFFFFFFF)))
-    fp_max = jnp.max(jnp.where(alive, fp, jnp.uint32(0)))
-    n_alive = jnp.sum(alive, dtype=jnp.int32)
-    return (fp_min == fp_max) & (n_alive > 0), fp_min, fp_max, n_alive
+    return fingerprint_agreement(state.alive, fp)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_ticks"))

@@ -72,7 +72,7 @@ from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
 from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k, pallas_oldest_k_supported
 from kaboodle_tpu.ops.fused_suspicion import fused_suspicion, pallas_suspicion_supported
-from kaboodle_tpu.ops.hashing import peer_record_hash
+from kaboodle_tpu.ops.hashing import fingerprint_agreement, peer_record_hash
 from kaboodle_tpu.ops.sampling import (
     bernoulli_matrix,
     broadcast_reply_prob,
@@ -476,10 +476,9 @@ def make_tick_fn(
 
         def _finish(S, T, lat, idv, kpr_partner_new, fp_g, n_g, fp_f, n_f, msgs):
             """Metrics + next-state assembly, shared by both branches."""
-            fpa_min = jnp.min(jnp.where(alive, fp_f, jnp.uint32(0xFFFFFFFF)))
-            fpa_max = jnp.max(jnp.where(alive, fp_f, jnp.uint32(0)))
-            n_alive = jnp.sum(alive, dtype=jnp.int32)
-            converged = (fpa_min == fpa_max) & (n_alive > 0)
+            converged, fpa_min, fpa_max, n_alive = fingerprint_agreement(
+                alive, fp_f
+            )
             agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
 
             new_state = MeshState(

@@ -42,12 +42,12 @@ def main() -> None:
     p.add_argument("--boot", choices=["none", "epidemic", "broadcast", "converged"],
                    default="epidemic",
                    help="converged = start from the everyone-knows-everyone "
-                        "state (ring_contacts=n-1) and assert the sharded "
-                        "all-reduce convergence check over one idle tick — "
-                        "for sizes where the join-avalanche boot tick's "
-                        "8-shard working set exceeds host RAM (N=65,536 "
-                        "OOM-kills 125 GiB even stepwise; the boot-to-"
-                        "convergence proof then runs at N=32,768)")
+                        "state (ring_contacts=n-1) and assert it through the "
+                        "standalone sharded all-reduce fingerprint check — "
+                        "NO protocol tick runs, so this lands at sizes where "
+                        "any full tick's 8-shard working set exceeds host "
+                        "RAM (N=65,536; the boot-to-convergence and "
+                        "full-fault proofs run at N<=32,768)")
     p.add_argument("--boot-max-ticks", type=int, default=512)
     p.add_argument("--drop-rate", type=float, default=0.05,
                    help="faulty-scan drop rate; 0 skips the [N, N] uniform "

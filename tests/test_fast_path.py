@@ -28,18 +28,22 @@ from kaboodle_tpu.sim.runner import simulate
 from kaboodle_tpu.sim.state import idle_inputs, init_state
 
 
+def _assert_leaves_equal(tree_a, tree_b):
+    for a, b in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)):
+        av, bv = np.asarray(a), np.asarray(b)
+        if av.dtype == np.float32:  # latency plane carries NaNs (no sample)
+            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all()
+        else:
+            assert (av == bv).all(), (av != bv).sum()
+
+
 def _trajectories_equal(st, inp, cfg):
     fast = jax.jit(lambda s, i: simulate(s, i, cfg, faulty=False))
     slow_cfg = dataclasses.replace(cfg, fast_path=False)
     slow = jax.jit(lambda s, i: simulate(s, i, slow_cfg, faulty=False))
     out_f, m_f = fast(st, inp)
     out_s, m_s = slow(st, inp)
-    for a, b in zip(jax.tree.leaves((out_f, m_f)), jax.tree.leaves((out_s, m_s))):
-        av, bv = np.asarray(a), np.asarray(b)
-        if av.dtype == np.float32:  # latency plane carries NaNs (no sample)
-            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all()
-        else:
-            assert (av == bv).all(), (av != bv).sum()
+    _assert_leaves_equal((out_f, m_f), (out_s, m_s))
     return m_f
 
 
@@ -108,3 +112,24 @@ def test_fast_path_routes_suspicion_to_full_path():
 
 def test_fast_path_default_on():
     assert SwimConfig().fast_path
+
+
+def test_fast_path_matches_full_sharded():
+    """The two-branch tick under GSPMD (the dispatch pred is a global
+    reduction the partitioner must all-reduce) produces the same sharded
+    trajectory as the single-path build."""
+    from kaboodle_tpu.parallel import make_mesh, shard_inputs, shard_state, simulate_sharded
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    n = 64
+    mesh = make_mesh(8)
+    cfg = SwimConfig()
+    slow_cfg = dataclasses.replace(cfg, fast_path=False)
+    inp = idle_inputs(n, ticks=12)
+
+    st = shard_state(init_state(n, seed=4, ring_contacts=2), mesh)
+    sharded_inp = shard_inputs(inp, mesh, stacked=True)
+    out_f, m_f = simulate_sharded(st, sharded_inp, cfg, mesh, faulty=False)
+    out_s, m_s = simulate_sharded(st, sharded_inp, slow_cfg, mesh, faulty=False)
+    _assert_leaves_equal((out_f, m_f), (out_s, m_s))

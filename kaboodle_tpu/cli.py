@@ -57,8 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
 def resolve_interface(spec: str | None) -> tuple[str, int, str]:
     """Resolve --interface to (ip, ifindex, broadcast_ip).
 
-    ``spec``: None (reference policy: IPv6-preferred), an explicit IP, or a
-    family name 'v4'/'v6' (main.rs:18-36 resolves name/ip/family similarly).
+    ``spec``: None (reference policy: IPv6-preferred), a family keyword
+    ('ipv4'/'ipv6', with 'v4'/'v6' accepted as shorthand), or an explicit IP
+    or device name — the reference resolves the same three forms, matching
+    IP or name uncanonicalized in one pass (main.rs:18-36).
     """
     from kaboodle_tpu.transport.native import list_interfaces
 
@@ -69,17 +71,17 @@ def resolve_interface(spec: str | None) -> tuple[str, int, str]:
     def bcast(i):
         return i["broadcast"] if i["family"] == 4 else "ff02::1213:1989"
 
-    if spec in ("v4", "v6"):
-        fam = 4 if spec == "v4" else 6
+    if spec in ("v4", "v6", "ipv4", "ipv6"):
+        fam = 4 if spec in ("v4", "ipv4") else 6
         for i in ifaces:
             if i["family"] == fam:
                 return i["ip"], i["ifindex"], bcast(i)
         raise NoAvailableInterfaces(f"no {spec} interface")
     if spec:
         for i in ifaces:
-            if i["ip"] == spec:
+            if i["ip"] == spec or i.get("name") == spec:
                 return i["ip"], i["ifindex"], bcast(i)
-        raise NoAvailableInterfaces(f"interface ip {spec!r} not found")
+        raise NoAvailableInterfaces(f"interface {spec!r} not found (by ip or name)")
     for i in ifaces:  # IPv6-preferred (networking.rs:12-23)
         if i["family"] == 6:
             return i["ip"], i["ifindex"], bcast(i)

@@ -147,6 +147,10 @@ def make_tick_fn(
     """
 
     det = cfg.deterministic
+    if _cut not in (None, "A", "c1", "c2", "c34", "G"):
+        # A typoed label would silently compile the normal full tick and a
+        # stage probe would bank a full-tick time as a phase-cut measurement.
+        raise ValueError(f"unknown _cut label {_cut!r}")
 
     def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:
         n = st.state.shape[-1]
@@ -500,7 +504,11 @@ def make_tick_fn(
                 messages_delivered=msgs,
                 converged=converged,
                 agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
-                mean_membership=jnp.sum(jnp.where(alive, n_f, 0)).astype(jnp.float32)
+                # f32 accumulation: an int32 sum wraps once alive x members
+                # exceeds 2^31 (N > ~46,341 converged) — reachable now that
+                # the chunked twin executes N=65,536 ticks; keep the two
+                # kernels' metrics bit-comparable.
+                mean_membership=jnp.sum(jnp.where(alive, n_f, 0).astype(jnp.float32))
                 / jnp.maximum(n_alive, 1),
                 fingerprint_min=fpa_min,
                 fingerprint_max=fpa_max,

@@ -122,13 +122,17 @@ def best_interface() -> tuple[str, int]:
 
 
 def list_interfaces() -> list[dict]:
-    """All non-loopback addresses: {family: 4|6, ip, ifindex, broadcast}."""
+    """All non-loopback addresses: {family: 4|6, ip, ifindex, broadcast, name}.
+
+    ``name`` is the OS device name (``eth0``), so ``--interface`` can resolve
+    by name like the reference (main.rs:18-36)."""
     lib = load_library()
     out = []
     for line in _take_string(lib, lib.kb_list_interfaces()).splitlines():
-        fam, ip, idx, bcast = line.split(",")
+        fam, ip, idx, bcast, name = (line.split(",") + [""])[:5]
         out.append(
-            {"family": int(fam), "ip": ip, "ifindex": int(idx), "broadcast": bcast}
+            {"family": int(fam), "ip": ip, "ifindex": int(idx),
+             "broadcast": bcast, "name": name}
         )
     return out
 

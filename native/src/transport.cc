@@ -182,8 +182,10 @@ std::optional<BroadcastPair> open_broadcast(const NetAddr& bcast_ip, uint16_t po
 }
 
 std::string list_interfaces() {
-  // One line per non-loopback address: "family,ip,ifindex,broadcast" where
-  // broadcast is the v4 subnet broadcast (empty for v6).
+  // One line per non-loopback address: "family,ip,ifindex,broadcast,name"
+  // where broadcast is the v4 subnet broadcast (empty for v6). The name
+  // lets --interface resolve by device name like the reference
+  // (main.rs:18-36 matches name or IP, uncanonicalized).
   ifaddrs* ifs = nullptr;
   if (getifaddrs(&ifs) != 0) return "";
   std::string out;
@@ -192,10 +194,11 @@ std::string list_interfaces() {
       continue;
     char host[INET6_ADDRSTRLEN] = {0};
     unsigned idx = if_nametoindex(i->ifa_name);
+    std::string name = i->ifa_name ? i->ifa_name : "";
     if (i->ifa_addr->sa_family == AF_INET6) {
       auto* s6 = reinterpret_cast<sockaddr_in6*>(i->ifa_addr);
       inet_ntop(AF_INET6, &s6->sin6_addr, host, sizeof(host));
-      out += "6," + std::string(host) + "," + std::to_string(idx) + ",\n";
+      out += "6," + std::string(host) + "," + std::to_string(idx) + ",," + name + "\n";
     } else if (i->ifa_addr->sa_family == AF_INET) {
       auto* s4 = reinterpret_cast<sockaddr_in*>(i->ifa_addr);
       inet_ntop(AF_INET, &s4->sin_addr, host, sizeof(host));
@@ -204,7 +207,8 @@ std::string list_interfaces() {
         auto* sb = reinterpret_cast<sockaddr_in*>(i->ifa_ifu.ifu_broadaddr);
         inet_ntop(AF_INET, &sb->sin_addr, bc, sizeof(bc));
       }
-      out += "4," + std::string(host) + "," + std::to_string(idx) + "," + bc + "\n";
+      out += "4," + std::string(host) + "," + std::to_string(idx) + "," + bc +
+             "," + name + "\n";
     }
   }
   freeifaddrs(ifs);

@@ -33,6 +33,29 @@ def test_resolve_interface():
         resolve_interface("203.0.113.77")
 
 
+def test_resolve_interface_by_name_and_family_keywords():
+    """--interface resolves by device name and ipv4/ipv6 keywords
+    (main.rs:18-36: name, IP, or family, uncanonicalized)."""
+    ifaces = list_interfaces()
+    if not ifaces:
+        pytest.skip("no interfaces")
+    named = [i for i in ifaces if i["name"]]
+    assert named, "list_interfaces must surface the device name"
+    first = named[0]
+    ip, idx, _ = resolve_interface(first["name"])
+    # Name matching returns the first address on that device (like the
+    # reference's .find()); assert it belongs to the named device.
+    matches = [i for i in ifaces if i["name"] == first["name"]]
+    assert any(i["ip"] == ip and i["ifindex"] == idx for i in matches)
+    fams = {i["family"] for i in ifaces}
+    if 4 in fams:
+        assert resolve_interface("ipv4") == resolve_interface("v4")
+    if 6 in fams:
+        assert resolve_interface("ipv6") == resolve_interface("v6")
+    with pytest.raises(NoAvailableInterfaces):
+        resolve_interface("no-such-device0")
+
+
 def test_format_peer_table():
     out = format_peer_table(
         "1.1.1.1:1",

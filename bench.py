@@ -1,7 +1,11 @@
 """Benchmark driver: simulated-peers·ticks/sec/chip + ticks-to-convergence.
 
-Prints ONE JSON line:
+Ends with ONE compact JSON line that always fits a stdout-tail capture:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+The full result document (per-config sections, banked captures) precedes it
+as a ``BENCHDOC``-tagged line and is mirrored to ``BENCH_last_full.json``
+(round 4's full-document-as-last-line overflowed the driver's tail buffer —
+BENCH_r04.json ``parsed: null``).
 
 Baseline: the reference has no published numbers (SURVEY.md §6); its
 demonstrated scale is the 2x2 zellij demo — 4 real peers at 1 tick/second
@@ -99,16 +103,14 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
     from kaboodle_tpu.sim.runner import run_until_converged, simulate
     from kaboodle_tpu.sim.state import idle_inputs, init_state
 
-    # Fused Pallas fingerprint pass on the single-chip TPU path (the GSPMD
-    # path keeps the jnp formulation — see SwimConfig.use_pallas_fp).
-    from kaboodle_tpu.ops.fused_fp import pallas_supported
-
-    use_pallas = jax.default_backend() == "tpu" and not sharded and pallas_supported(n)
-    cfg = SwimConfig(
-        use_pallas_fp=use_pallas,
-        use_pallas_oldest_k=use_pallas,
-        use_pallas_suspicion=use_pallas,
-    )
+    # Per-stage Pallas kernels are OFF by default since round 5: the
+    # round-4c scan-amortized audit measured the jnp formulations winning
+    # (oldest-5: 1.52 ms jnp vs 12.3 ms fused_oldest_k; fingerprint: 1.95
+    # vs 2.15 ms at N=16,384 — PERF.md "Pallas policy"), and the fast-path
+    # tick composes the jnp forms anyway. The kernels stay in-tree and
+    # tested; the watcher's A/B variants keep measuring both so a future
+    # window can re-open the question with data.
+    cfg = SwimConfig()
     lean = n >= LEAN_STATE_MIN_N
     # int16 timers are only valid below ~32k ticks (init_state contract).
     # The decision uses the BASE scan length; the adaptive timing floor
@@ -116,7 +118,9 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
     # budgeting for worst-case growth here instead would flip every default
     # run to int32 (2.8 -> 4.8 ms/sweep, PERF.md round-4c) for a floor that
     # only ever engages at small N.
-    narrow = lean and ticks < jnp.iinfo(jnp.int16).max
+    # (<= 32000, the same ceiling _floor_cap enforces: a base scan in
+    # 32001..32766 would erase the headroom margin the cap promises.)
+    narrow = lean and ticks <= 32000
     st = init_state(n, seed=0, track_latency=not lean, instant_identity=lean,
                     timer_dtype=jnp.int16 if narrow else jnp.int32)
     rtt = _null_rtt()
@@ -153,26 +157,9 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
             return i
 
     # (a) convergence: compile first (cached), then time a fresh run. The
-    # int() fetches force real execution through the tunnel. If a Pallas
-    # kernel fails real-Mosaic lowering (interpret-mode tests can't catch
-    # that), fall back to the jnp formulations rather than losing the
-    # window: a slower number beats none.
-    try:
-        _, conv_ticks, conv = _converge(st)
-        int(conv_ticks)
-    except Exception as e:
-        # OOM must surface to main's step-down loop immediately: re-running
-        # the full jnp convergence at the same N would likely OOM again and
-        # burn scarce live-TPU window time. Only compile/lowering failures
-        # of the Pallas path fall back.
-        if not use_pallas or _is_oom(e):
-            raise
-        print("bench: pallas path failed to compile; falling back to jnp",
-              file=sys.stderr)
-        use_pallas = False
-        cfg = SwimConfig()
-        _, conv_ticks, conv = _converge(st)
-        int(conv_ticks)
+    # int() fetches force real execution through the tunnel.
+    _, conv_ticks, conv = _converge(st)
+    int(conv_ticks)
     t0 = time.perf_counter()
     _, conv_ticks, conv = _converge(st)
     conv_ticks_v = int(conv_ticks)
@@ -226,7 +213,7 @@ def _bench(n: int, ticks: int, warmup: int = 1, sharded: bool = False,
         "peers_ticks_per_sec": n * ticks / elapsed,
         "null_rtt_s": rtt,
         "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
-        "pallas_fp": use_pallas,
+        "pallas_fp": False,  # per-stage Pallas kernels demoted (see cfg note)
         "peak_hbm_mib": _peak_device_memory_mib(),
     }
 
@@ -694,6 +681,27 @@ def main() -> None:
             print(f"bench: detection failed ({type(e).__name__})", file=sys.stderr)
             detection = {"error": type(e).__name__}
 
+    # Fold the measured recovery numbers into the config-3 section (VERDICT
+    # r4 item 5): the 64-tick throughput window cannot contain the ~1.3N-tick
+    # recovery, so a driver reading churn_config3 alone used to see nulls.
+    # The authoritative reconvergence measurement is _bench_churn_recovery's;
+    # it runs at its own (smaller) N, recorded alongside.
+    # The two sections fold independently so one erroring never drops the
+    # other's verdict from the compact line.
+    recovery_ok = isinstance(recovery, dict) and "error" not in recovery
+    if isinstance(churn, dict) and "error" not in churn:
+        churn = {
+            **churn,
+            "reconverged": bool(churn.get("reconverged_in_window"))
+            or (recovery_ok and bool(recovery.get("reconverged"))),
+        }
+        if recovery_ok:
+            churn["measured_recovery"] = recovery
+            if not churn.get("reconverged_in_window") and recovery.get("reconverged"):
+                churn["reconverge_ticks_after_churn"] = recovery.get(
+                    "reconverge_ticks_after_churn")
+                churn["reconverge_measured_at_n"] = recovery.get("n")
+
     value = result["peers_ticks_per_sec"] / n_chips
     # Reference demonstrated rate: 4 peers x 1 tick/s on one whole machine.
     baseline = 4.0
@@ -771,7 +779,63 @@ def main() -> None:
             line["banked_tpu_capture"] = {"source": os.path.basename(path), **data}
         else:
             print("bench: no banked on-TPU capture to attach", file=sys.stderr)
-    print(json.dumps(line))
+
+    # Output contract (VERDICT r4 item 5): the full document overflowed the
+    # driver's stdout-tail buffer in round 4 (BENCH_r04.json parsed: null),
+    # so it now rides a tagged BENCHDOC line (+ a repo-side file) and the
+    # process ENDS with one compact single-line JSON summary that always
+    # parses from a tail capture. Readers that want detail follow the tag or
+    # the file; machine consumers take the last line.
+    doc = json.dumps(line)
+    print("BENCHDOC " + doc)
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(root, "BENCH_last_full.json"), "w") as f:
+            f.write(doc + "\n")
+    except OSError as e:
+        print(f"bench: could not write BENCH_last_full.json: {e}", file=sys.stderr)
+
+    def _sec(d, *keys):
+        """Terse verdict from a section dict: just the named keys."""
+        if not isinstance(d, dict):
+            return None
+        if "error" in d:
+            return {"error": d["error"], "n": d.get("n")}
+        return {k: d[k] for k in keys if k in d}
+
+    compact = {
+        "metric": line["metric"],
+        "value": line["value"],
+        "unit": line["unit"],
+        "vs_baseline": line["vs_baseline"],
+        "n_peers": line["n_peers"],
+        "n_chips": n_chips,
+        "sharded": sharded,
+        "backend": line["backend"],
+        "state_variant": line["state_variant"],
+        "converged": line["converged"],
+        "boot_ticks": line["ticks_to_convergence_broadcast_boot"],
+        "peak_rss_mib": line["peak_rss_mib"],
+        "gossip_boot_ok": (None if gossip is None
+                           else all(g["converged"] for g in gossip)),
+        "epidemic_boot_ok": (None if epidemic is None
+                             else all(g["converged"] for g in epidemic)),
+        "churn_config3": _sec(churn, "n", "reconverged",
+                              "reconverge_ticks_after_churn",
+                              "reconverge_measured_at_n", "peers_ticks_per_sec"),
+        "partition_heal": _sec(heal, "n", "reconverged",
+                               "reconverge_ticks_after_heal"),
+        "detection_within_bound": (detection or {}).get("within_bound"),
+        "recovery_at_scale_ok": banked_recovery is not None,
+        "full_doc": "BENCH_last_full.json",
+    }
+    if "banked_tpu_capture" in line:
+        cap = line["banked_tpu_capture"]
+        compact["banked_tpu_capture"] = {
+            "source": cap.get("source"), "value": cap.get("value"),
+            "n_peers": cap.get("n_peers"), "backend": cap.get("backend"),
+        }
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

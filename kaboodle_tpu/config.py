@@ -96,7 +96,10 @@ class SwimConfig:
     # one guaranteed HBM pass, no [N, N] intermediates. Single-device only
     # (the GSPMD path keeps the jnp form, which XLA partitions row-locally);
     # requires N % 128 == 0. Off TPU it runs in pallas interpreter mode
-    # (correct but slow) — bench.py enables it on the single-chip TPU path.
+    # (correct but slow). Demoted to tested-but-off in round 5: the
+    # round-4c scan-amortized audit measured the jnp formulation faster
+    # in-context (PERF.md "Pallas policy"), so bench no longer enables the
+    # per-stage kernels anywhere.
     use_pallas_fp: bool = False
     # How the ping-target draw finds each row's oldest-k Known peers
     # (kaboodle.rs:661-675): "topk" = jax.lax.top_k (sort-based on TPU),
@@ -109,13 +112,14 @@ class SwimConfig:
     # rounds) in one fused Pallas pass over the state/timer tiles instead of
     # k+1 jnp passes — bit-exact with the "iter" method (and so with stable
     # top_k); single-device, N % 128 == 0, interpret-mode off TPU, like
-    # use_pallas_fp. bench.py enables it on the single-chip TPU path.
+    # use_pallas_fp. Demoted to tested-but-off (see use_pallas_fp note;
+    # this kernel lost its scan-amortized A/B 8x — PERF.md).
     use_pallas_oldest_k: bool = False
     # Compute the phase-A row statistics (membership count, timed-suspect
     # argmin, proxy-candidate existence) in one fused Pallas pass over
     # (state, timer) instead of 3-4 jnp passes — bit-exact
     # (tests/test_fused_suspicion.py); same constraints as the other fused
-    # kernels. bench.py enables it on the single-chip TPU path.
+    # kernels. Demoted to tested-but-off (see use_pallas_fp note).
     use_pallas_suspicion: bool = False
     # Fault-free builds compile a two-branch tick: a lean path for ticks with
     # no join broadcast and no suspicion activity (the overwhelming majority

@@ -142,6 +142,28 @@ def main() -> None:
             ["git", "worktree", "add", "--detach", WORKTREE, PRE_GATE_REF],
             cwd=REPO_ROOT, check=True,
         )
+    else:
+        # A stale worktree from an earlier run would silently corrupt the
+        # pregate arm: force-checkout the pinned rev (covers both HEAD drift
+        # and dirty tracked files); recreate the worktree if its metadata is
+        # broken (pruned/moved).
+        pinned = subprocess.run(
+            ["git", "rev-parse", PRE_GATE_REF], cwd=REPO_ROOT,
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        reset = subprocess.run(
+            ["git", "checkout", "--force", "--detach", pinned],
+            cwd=WORKTREE, capture_output=True, text=True,
+        )
+        if reset.returncode != 0:
+            import shutil
+
+            shutil.rmtree(WORKTREE, ignore_errors=True)
+            subprocess.run(["git", "worktree", "prune"], cwd=REPO_ROOT, check=False)
+            subprocess.run(
+                ["git", "worktree", "add", "--detach", WORKTREE, PRE_GATE_REF],
+                cwd=REPO_ROOT, check=True,
+            )
     res = {"ts": time.time(), "kind": "gate_ab", "pre_gate_rev": rev}
     res["gated"] = _arm(str(REPO_ROOT))
     res["pregate"] = _arm(WORKTREE)

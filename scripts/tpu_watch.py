@@ -117,8 +117,76 @@ tick_ab(16384)
 # superseded by the scan-amortized scripts/tpu_stage_probe.py — its numbers
 # were dispatch-floor bound; the banked captures remain in TPU_WATCH.log.)
 
+# ---- 2. The chunked kernel on-chip (VERDICT r4 items 2-3) ------------------
+# (a) its transient bound on TPU at the headline N; (b) the N=32,768 ceiling:
+# every whole-tick 32k compile 500s through the remote compile helper
+# (PERF.md); the chunked program is a handful of small lax.map bodies, so it
+# probes whether the ceiling is program size.
+from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+from kaboodle_tpu.sim.state import TickInputs
+
+def chunked_tick_ms(tick_n, block=2048, reps=4):
+    cfg = SwimConfig()
+    st = init_state(tick_n, seed=0, ring_contacts=tick_n - 1,
+                    track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
+    idle1 = TickInputs(
+        kill=jnp.zeros((tick_n,), bool), revive=jnp.zeros((tick_n,), bool),
+        partition=jnp.zeros((tick_n,), jnp.int32),
+        drop_rate=jnp.float32(0), manual_target=jnp.full((tick_n,), -1, jnp.int32),
+    )
+    tick = jax.jit(make_chunked_tick_fn(cfg, faulty=True, block=block, drop=False))
+
+    def run(s):
+        o, _ = tick(s, idle1)
+        return o
+
+    out = run(st)
+    jax.block_until_ready(out)
+    float(jnp.asarray(out.timer.ravel()[0]).astype(jnp.float32))
+    t0 = time.perf_counter()
+    s = out
+    for _ in range(reps):
+        s = run(s)
+    float(jnp.asarray(s.timer.ravel()[0]).astype(jnp.float32))
+    return (time.perf_counter() - t0) / reps * 1e3
+
+for cn in (16384, 32768):
+    try:
+        out[f"chunked_tick_n{cn}_ms"] = chunked_tick_ms(cn)
+    except Exception as e:
+        out[f"chunked_tick_n{cn}_error"] = repr(e)[:300]
+
 # ---- 3. The single-chip ceiling size last ----------------------------------
 tick_ab(32768)
+
+# AOT attempt at the 32k whole-tick ceiling (VERDICT r4 item 3): lower() +
+# compile() splits tracing from backend compilation; if the HTTP 500 is in
+# the remote compile transport, the failure point (and error text) moves.
+try:
+    from kaboodle_tpu.sim.runner import simulate as _sim
+    cfg32 = SwimConfig()
+    st32 = init_state(32768, seed=0, ring_contacts=32767,
+                      track_latency=False, instant_identity=True,
+                      timer_dtype=jnp.int16)
+    inp32 = idle_inputs(32768, ticks=8)
+
+    def _run32(s, i):
+        o, _ = _sim(s, i, cfg32, faulty=False)
+        return o.timer.sum() + o.tick
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(_run32).lower(st32, inp32)
+    out["aot32k_lower_s"] = round(time.perf_counter() - t0, 1)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    out["aot32k_compile_s"] = round(time.perf_counter() - t0, 1)
+    t0 = time.perf_counter()
+    r = compiled(st32, inp32)
+    float(jnp.asarray(r).astype(jnp.float32))
+    out["aot32k_run8_s"] = round(time.perf_counter() - t0, 1)
+except Exception as e:
+    out["aot32k_error"] = repr(e)[:400]
 
 # What does the axon device report for memory accounting? (bench's
 # peak_hbm_mib came back null; record the raw keys so it can be fixed.)
@@ -178,17 +246,21 @@ def _run_group(cmd: list[str], timeout_s: int):
 
 
 def find_metric_line(out: str) -> str | None:
-    """Last stdout line that is the bench's JSON result (stderr is merged
-    into the capture, so detect by the "metric" key, not position)."""
+    """The bench's full result document: the BENCHDOC-tagged line (round-5
+    output contract), falling back to the last bare JSON line with a
+    "metric" key (the compact summary / older builds)."""
+    fallback = None
     for ln in reversed(out.splitlines()):
         ln = ln.strip()
-        if ln.startswith("{"):
+        if ln.startswith("BENCHDOC {"):
+            return ln[len("BENCHDOC "):]
+        if fallback is None and ln.startswith("{"):
             try:
                 if "metric" in json.loads(ln):
-                    return ln
+                    fallback = ln
             except json.JSONDecodeError:
                 continue
-    return None
+    return fallback
 
 
 def probe() -> bool:
@@ -256,7 +328,7 @@ def main() -> None:
                 # warm-up) overwrite a better already-banked headline.
                 try:
                     data = json.loads(result)
-                    path = REPO_ROOT / "BENCH_r04_local.json"
+                    path = REPO_ROOT / "BENCH_r05_local.json"
                     prev = -1.0
                     try:
                         prev = float(json.loads(path.read_text())["value"])

@@ -53,33 +53,23 @@ ci: lint native test
 scale-proof:
 	$(PYTHON) scripts/sharded_scale_proof.py --n 8192 --devices 8 --ticks 8 --boot epidemic
 
-# North-star scale (BASELINE configs 4-5): N=65,536 lean+int16 sharded.
-# Converged-init (ring_contacts=n-1) asserted by the standalone sharded
-# all-reduce fingerprint check (one masked state read — any FULL tick's
-# XLA:CPU working set exceeds this emulating host at this N: ~131 GiB
-# single-path, ~174 GiB with the split tick; OOM-killed four times, see
-# SCALE_PROOF.md attempts 1-3/5-6). This target always completes; the
-# best-effort single faulty tick lives in scale-proof-65k-faulty.
-# Drop stays off: the [N, N] uniform draw alone is 16 GiB at this N.
-# XLA's CPU in-process collectives abort if a rendezvous waits > 40 s — at
-# this size each single-core shard computes for minutes between
-# collectives, so the target raises both timeout flags itself.
+# North-star scale (BASELINE config 4): REAL full-protocol faulty ticks at
+# N=65,536 via the chunked (row-blocked) kernel — converged-init asserted
+# through the standalone fingerprint check, then kills + partition + manual
+# pings, with suspicion/escalation/indirect pings firing from tick 2 on.
+# The whole-tensor kernel cannot execute ANY tick at this N on the
+# emulating host (8 OOM-killed attempts, SCALE_PROOF.md); the chunked
+# kernel bounds transients to O(block*N) and lands it in ~4 GiB + state.
 scale-proof-65k:
+	$(PYTHON) scripts/chunked_scale_proof.py --n 65536 --block 2048 --ticks 4
+
+# The pre-round-5 sharded converged-init assertion (GSPMD all-reduce check,
+# no protocol tick) — kept as the mesh-path complement of scale-proof-65k.
+scale-proof-65k-sharded-assert:
 	XLA_FLAGS="--xla_cpu_collective_call_terminate_timeout_seconds=21600 \
 	  --xla_cpu_collective_timeout_seconds=21600 $$XLA_FLAGS" \
 	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 0 \
 	  --boot converged
-
-# One steady-state faulty tick at the north-star N — best-effort on the
-# emulating host (the tick's working set needs the swapfiles and may still
-# be OOM-killed; the boot assertion from scale-proof-65k stands either
-# way, and the full fault schedule is proven at N=32,768 by
-# scale-proof-32k).
-scale-proof-65k-faulty:
-	XLA_FLAGS="--xla_cpu_collective_call_terminate_timeout_seconds=21600 \
-	  --xla_cpu_collective_timeout_seconds=21600 $$XLA_FLAGS" \
-	$(PYTHON) scripts/sharded_scale_proof.py --n 65536 --devices 8 --ticks 1 \
-	  --boot converged --drop-rate 0 --faulty-runs 1 --stepwise --no-revive
 
 # Broadcast-boot to asserted convergence + the FULL fault schedule (revive
 # included) at the largest N whose join tick fits the emulating host.

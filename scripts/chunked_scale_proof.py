@@ -1,0 +1,171 @@
+"""North-star-scale protocol ticks via the chunked (row-blocked) kernel.
+
+The whole-tensor kernel cannot execute any tick at N=65,536 on the
+emulating host — eight documented attempts OOM-killed a 125 GiB machine
+(SCALE_PROOF.md attempts 1-6, 8): XLA:CPU materializes enough [N, N]
+int32-scale temporaries per tick to exceed RAM no matter how the run is
+staged. ``make_chunked_tick_fn`` (sim/chunked.py) bounds every pass to
+O(block·N) transients, which turns the 65k *full protocol tick* from
+impossible into routine on this host.
+
+Single-device by design (the chunked kernel's documented scope): the
+sharded story — GSPMD behavior, collectives, multihost — is proven by
+scripts/sharded_scale_proof.py at N<=32,768; THIS proof is about executing
+the full tick at the north-star N with real fault inputs. The join
+avalanche (all-N broadcast boot) remains out of scope at 65k for compute,
+not memory: the O(N^3) gossip-union contraction is ~2.8e14 int8-ops, days
+on this host's single core (it rides 8 MXUs on the real v5e-8); the
+revive/join machinery at scale is scale-proof-32k's job.
+
+Phases (PHASE lines bank incrementally; one final JSON line):
+1. converged-init state (lean+int16), asserted through the standalone
+   fingerprint-agreement check (parallel.sharded_convergence_check — the
+   same predicate, single-device here).
+2. ``--ticks`` faulty ticks, stepwise with donated carry: kills at tick 0
+   (suspicion -> escalation -> indirect pings fire from tick
+   ping_timeout+1 on), a partition window, manual pings each tick.
+   Drop stays off (the budget notes in sim/chunked.py; pass --drop-rate
+   to exercise the D10 resident at smaller N).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rss_mib() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=65536)
+    p.add_argument("--block", type=int, default=2048)
+    p.add_argument("--ticks", type=int, default=4)
+    p.add_argument("--kill-count", type=int, default=64)
+    p.add_argument("--drop-rate", type=float, default=0.0)
+    args = p.parse_args()
+
+    from axon_guard import strip_axon_plugin
+
+    strip_axon_plugin()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.parallel import sharded_convergence_check
+    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+    from kaboodle_tpu.sim.state import TickInputs, init_state
+
+    n, ticks, block = args.n, args.ticks, args.block
+    line = {
+        "n": n,
+        "block": block,
+        "devices": 1,
+        "backend": jax.default_backend(),
+        "kernel": "chunked",
+        "state_variant": "lean+int16",
+    }
+
+    # ---- phase 1: converged init, asserted -------------------------------
+    t0 = time.perf_counter()
+    st = init_state(n, seed=0, ring_contacts=n - 1,
+                    track_latency=False, instant_identity=True,
+                    timer_dtype=jnp.int16)
+    conv, _, _, n_alive = sharded_convergence_check(st)
+    assert bool(conv) and int(n_alive) == n
+    line["boot"] = {
+        "mode": "converged",
+        "converged": True,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    print("PHASE " + json.dumps({**line["boot"], "peak_rss_mib": _rss_mib()}),
+          flush=True)
+
+    # ---- phase 2: the full faulty tick, stepwise -------------------------
+    # Kills at tick 0 so later ticks bear the suspicion -> escalation ->
+    # indirect-ping -> removal machinery at full N; partition from tick 1;
+    # one manual ping per tick from peer 0.
+    cfg = SwimConfig()
+    rng = np.random.default_rng(0)
+    kill_idx = rng.choice(n, size=min(args.kill_count, n // 2), replace=False)
+    drop = args.drop_rate > 0
+    tick_fn = jax.jit(
+        make_chunked_tick_fn(cfg, faulty=True, block=block, drop=drop),
+        donate_argnums=0,
+    )
+
+    t0 = time.perf_counter()
+    msgs_per_tick = []
+    for t in range(ticks):
+        kill = np.zeros((n,), bool)
+        if t == 0:
+            kill[kill_idx] = True
+        part = np.zeros((n,), np.int32)
+        if t >= 1:
+            part[: n // 2] = 1
+        man = np.full((n,), -1, np.int32)
+        man[0] = 1
+        inp = TickInputs(
+            kill=jnp.asarray(kill),
+            revive=jnp.zeros((n,), bool),
+            partition=jnp.asarray(part),
+            drop_rate=jnp.float32(args.drop_rate),
+            manual_target=jnp.asarray(man),
+        )
+        st, m = tick_fn(st, inp)
+        msgs = int(m.messages_delivered)
+        msgs_per_tick.append(msgs)
+        print("PHASE " + json.dumps({
+            "faulty_tick": t,
+            "messages_delivered": msgs,
+            "converged": bool(m.converged),
+            "mean_membership": round(float(m.mean_membership), 1),
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "peak_rss_mib": _rss_mib(),
+        }), flush=True)
+    run_s = time.perf_counter() - t0
+
+    alive = np.asarray(st.alive)
+    assert int(alive.sum()) == n - len(kill_idx)
+    assert all(m > 0 for m in msgs_per_tick)
+    esc_ticks = max(0, ticks - cfg.ping_timeout_ticks)
+    if esc_ticks:
+        # Direct evidence the suspicion/escalation path executed at this N:
+        # survivors escalated timed-out dead-peer entries to
+        # WaitingForIndirectPing (removal of those entries takes a further
+        # ping_timeout, so with ticks <= timeout + ~2N they must be visible).
+        from kaboodle_tpu.spec import WAITING_FOR_INDIRECT_PING
+
+        state = np.asarray(st.state)
+        assert (state[alive] == WAITING_FOR_INDIRECT_PING).any(), (
+            "no escalation reached WaitingForIndirectPing — the suspicion "
+            "path did not execute")
+    line.update({
+        "ticks": ticks,
+        "drop_rate": args.drop_rate,
+        "killed": int(len(kill_idx)),
+        "run_s": round(run_s, 3),
+        "run_includes_compile": True,
+        "messages_per_tick": msgs_per_tick,
+        "escalation_bearing_ticks": esc_ticks,
+        "escalation_asserted": bool(esc_ticks),
+        "peak_rss_mib": _rss_mib(),
+        "faulty": True,
+    })
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

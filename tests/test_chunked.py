@@ -1,0 +1,171 @@
+"""Chunked (row-blocked) tick vs the whole-tensor kernel: exact parity.
+
+``make_chunked_tick_fn`` re-expresses the tick as lax.map passes over row
+blocks so peak transients are O(block·N) — the N=65,536 enabler
+(sim/chunked.py docstring). Its contract is bit-exact trajectory equality
+with ``make_tick_fn`` whenever only per-row draws are consumed: all of
+deterministic mode, and random mode away from the matrix-draw branches
+(deviation D10). These tests pin that contract over trajectories that
+exercise every phase: the join avalanche, churn kill/revive (revive
+re-enters through the join path), partitions, random-but-pinned drop
+matrices, manual pings, suspicion escalation, indirect pings, calls 3-4
+forwarding, and anti-entropy shares.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import TickInputs, idle_inputs, init_state
+
+
+def _assert_leaves_equal(tree_a, tree_b, tick=None):
+    for a, b in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)):
+        av, bv = np.asarray(a), np.asarray(b)
+        if av.dtype == np.float32:  # latency carries NaNs (no sample yet)
+            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all(), tick
+        else:
+            assert (av == bv).all(), (tick, (av != bv).sum())
+
+
+def _fault_schedule(n: int, ticks: int, drop: bool = True) -> TickInputs:
+    """Every fault path: kills (-> escalations -> removals), a revive
+    (join re-entry), a partition window, manual pings, pinned drop."""
+    rng = np.random.default_rng(7)
+    kill = np.zeros((ticks, n), bool)
+    kill[5, [3, min(7, n - 1)]] = True
+    rev = np.zeros((ticks, n), bool)
+    rev[12, 3] = True
+    part = np.zeros((ticks, n), np.int32)
+    part[15:20, : n // 2] = 1
+    man = np.full((ticks, n), -1, np.int32)
+    man[8, 0] = min(9, n - 1)
+    man[22, 4] = min(17, n - 1)
+    drop_ok = (rng.random((ticks, n, n)) > 0.15) if drop else np.ones(
+        (ticks, n, n), bool)
+    return TickInputs(
+        kill=jnp.asarray(kill),
+        revive=jnp.asarray(rev),
+        partition=jnp.asarray(part),
+        drop_rate=jnp.zeros((ticks,), jnp.float32),
+        manual_target=jnp.asarray(man),
+        drop_ok=jnp.asarray(drop_ok),
+    )
+
+
+def _run_parity(st, inp, cfg, faulty, block, ticks):
+    tick_a = jax.jit(make_tick_fn(cfg, faulty=faulty))
+    tick_b = jax.jit(make_chunked_tick_fn(cfg, faulty=faulty, block=block))
+    sa = sb = st
+    for t in range(ticks):
+        it = jax.tree.map(lambda x: x[t], inp)
+        sa, ma = tick_a(sa, it)
+        sb, mb = tick_b(sb, it)
+        _assert_leaves_equal((sa, ma), (sb, mb), tick=t)
+    return sa
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lean", [False, True])
+def test_chunked_parity_full_fault_schedule(lean):
+    """Deterministic faulty trajectory, every fault path, full vs lean
+    state planes, block 8 over N=24."""
+    n, ticks = 24, 30
+    cfg = SwimConfig(deterministic=True)
+    st = init_state(n, seed=1, track_latency=not lean, instant_identity=lean,
+                    timer_dtype=jnp.int16 if lean else jnp.int32)
+    _run_parity(st, _fault_schedule(n, ticks), cfg, True, 8, ticks)
+
+
+@pytest.mark.slow
+def test_chunked_parity_share_cap():
+    """D5 cap active (max_share_peers < N): the capped-share branch of the
+    blocked gossip union — the branch that actually runs at N=65,536 —
+    against the whole-tensor kernel's, over join-bearing ticks."""
+    n, ticks = 24, 30
+    cfg = SwimConfig(deterministic=True, max_share_peers=8)
+    st = init_state(n, seed=4)
+    _run_parity(st, _fault_schedule(n, ticks), cfg, True, 8, ticks)
+
+
+@pytest.mark.slow
+def test_chunked_parity_epidemic_boot():
+    """Join broadcasts compiled out (gossip boot, fresh stamps): the
+    chunked path with no join machinery at all."""
+    n, ticks = 32, 24
+    cfg = SwimConfig(deterministic=True, join_broadcast_enabled=False,
+                     backdate_gossip_inserts=False)
+    st = init_state(n, seed=0, ring_contacts=2)
+    inp = idle_inputs(n, ticks=ticks)
+    out = _run_parity(st, inp, cfg, False, 8, ticks)
+    assert int(out.tick) == ticks
+
+
+@pytest.mark.slow
+def test_chunked_parity_random_mode_vector_draws_only():
+    """Random mode is exact while only the per-row ping draw is consumed
+    (no joins, no escalation, no random drop): converged-init idle ticks."""
+    n, ticks = 32, 12
+    cfg = SwimConfig(deterministic=False, join_broadcast_enabled=False)
+    st = init_state(n, seed=5, ring_contacts=n - 1)
+    inp = idle_inputs(n, ticks=ticks)
+    _run_parity(st, inp, cfg, False, 16, ticks)
+
+
+@pytest.mark.slow
+def test_chunked_parity_intended_semantics():
+    """Non-default parity flags: Failed broadcasts deliver (the chunked
+    blocked contraction replaces kernel.py's O(N^3) matmul) and forwarded
+    indirect acks clear suspicion."""
+    n, ticks = 24, 30
+    cfg = SwimConfig(deterministic=True, faithful_failed_broadcast=False,
+                     faithful_indirect_ack=False)
+    st = init_state(n, seed=2)
+    _run_parity(st, _fault_schedule(n, ticks), cfg, True, 8, ticks)
+
+
+def test_chunked_single_block_and_bad_block():
+    n = 16
+    cfg = SwimConfig(deterministic=True)
+    st = init_state(n, seed=0)
+    inp = idle_inputs(n, ticks=4)
+    _run_parity(st, inp, cfg, False, n, 4)  # block == N
+    with pytest.raises(ValueError, match="does not divide"):
+        jax.jit(make_chunked_tick_fn(cfg, faulty=False, block=5))(
+            st, jax.tree.map(lambda x: x[0], inp))
+
+
+@pytest.mark.slow
+def test_chunked_random_drop_converges():
+    """D10 smoke: random-mode chunked with drop_rate > 0 uses per-block
+    drop streams (distributional, not samplewise, parity) — assert the
+    protocol still behaves: a converged mesh stays converged under 10%
+    drop and the kill path still removes a dead peer. The budget rides the
+    ~2N-tick removal-completeness bound (SURVEY §6) plus drop slack."""
+    n, ticks = 32, 96
+    cfg = SwimConfig(deterministic=False)
+    st = init_state(n, seed=3, ring_contacts=n - 1)
+    kill = np.zeros((ticks, n), bool)
+    kill[0, 5] = True
+    inp = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=jnp.zeros((ticks, n), bool),
+        partition=jnp.zeros((ticks, n), jnp.int32),
+        drop_rate=jnp.full((ticks,), 0.1, jnp.float32),
+        manual_target=jnp.full((ticks, n), -1, jnp.int32),
+    )
+    tick = jax.jit(make_chunked_tick_fn(cfg, faulty=True, block=8))
+    sb = st
+    for t in range(ticks):
+        sb, m = tick(sb, jax.tree.map(lambda x: x[t], inp))
+    # Every survivor must have dropped the dead peer by ~2N calm ticks.
+    state = np.asarray(sb.state)
+    alive = np.asarray(sb.alive)
+    assert not state[alive][:, 5].any()
+    assert bool(np.asarray(m.converged))

@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--port", type=int, default=7475, help="broadcast/multicast port")
     p.add_argument("--probe", action="store_true", help="discover one member and exit")
+    p.add_argument("--probe-timeout", type=float, default=0.0, metavar="S",
+                   help="give up probing after S seconds (0 = retry forever "
+                        "with the reference's 1s x1.25 backoff)")
     p.add_argument("--ping", action="append", default=[], metavar="ADDR",
                    help="manually ping ADDR after start (repeatable)")
     p.add_argument("--period-ms", type=int, default=1000, help="protocol period")
@@ -148,9 +151,12 @@ def run_real(args) -> int:
     ip, idx, bcast_ip = resolve_interface(args.interface)
 
     if args.probe:
-        # Probe mode (main.rs:70-84): find one member, print, exit.
+        # Probe mode (main.rs:70-84): find one member, print, exit. The
+        # default retries forever like the reference's discover_mesh_member
+        # (discovery.rs:51-72); --probe-timeout bounds it.
         res = discover_mesh_member(
-            args.port, interface_ip=ip, broadcast_ip=bcast_ip, iface_index=idx
+            args.port, interface_ip=ip, broadcast_ip=bcast_ip, iface_index=idx,
+            total_timeout_ms=int(args.probe_timeout * 1000),
         )
         if res is None:
             print("no mesh member found", file=sys.stderr)

@@ -236,7 +236,9 @@ def probe_mesh(
     total_timeout_ms: int = 30000,
 ) -> tuple[str, bytes] | None:
     """discover_mesh_member (discovery.rs:30-89): find one mesh member without
-    joining. Returns (addr, identity) or None on timeout."""
+    joining. Returns (addr, identity) or None on timeout.
+    ``total_timeout_ms=0`` retries forever with the 1 s x1.25 (cap 10 s)
+    backoff, like the reference (discovery.rs:51-72)."""
     lib = load_library()
     s = _take_string(
         lib,

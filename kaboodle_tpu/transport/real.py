@@ -163,7 +163,9 @@ def discover_mesh_member(
     total_timeout_ms: int = 30000,
     **probe_kwargs,
 ) -> tuple[str, bytes] | None:
-    """Probe for any mesh member without joining (lib.rs:359-368)."""
+    """Probe for any mesh member without joining (lib.rs:359-368).
+    ``total_timeout_ms=0`` retries forever like the reference; the default
+    deadline is a library-convenience deviation (PARITY.md)."""
     if interface_ip is None:
         interface_ip, iface_index = best_interface()
     return probe_mesh(

@@ -568,14 +568,18 @@ std::string probe_mesh(const NetAddr& bind_ip, const NetAddr& bcast_ip, uint16_t
   Bytes out = encode_broadcast(probe);
 
   using Clock = std::chrono::steady_clock;
-  auto overall = Clock::now() + std::chrono::milliseconds(total_timeout_ms);
+  // total_timeout_ms == 0: retry forever with the same backoff — the
+  // reference's discover_mesh_member never gives up (discovery.rs:51-72).
+  bool forever = total_timeout_ms == 0;
+  auto overall = Clock::now() + std::chrono::milliseconds(
+                                    forever ? 1 : total_timeout_ms);
   double interval = start_ms;
   std::vector<uint8_t> buf(1024, 0);  // discovery.rs:16
 
-  while (Clock::now() < overall) {
+  while (forever || Clock::now() < overall) {
     bp->out.send_to(out.data(), out.size(), bp->dest);
     auto wait_until = Clock::now() + std::chrono::milliseconds(uint32_t(interval));
-    while (Clock::now() < wait_until && Clock::now() < overall) {
+    while (Clock::now() < wait_until && (forever || Clock::now() < overall)) {
       pollfd fd{us->fd, POLLIN, 0};
       ::poll(&fd, 1, 20);
       NetAddr sender;

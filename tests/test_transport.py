@@ -206,6 +206,14 @@ def test_probe_discovers_member_without_joining(iface4):
         assert ident in {b"pane-0", b"pane-1"}
         # The prober did not join: peer counts unchanged.
         assert all(len(e.peers()) == 2 for e in engines)
+        # total_timeout_ms=0 = the reference's retry-forever mode
+        # (discovery.rs:51-72); with a member up it returns on the first
+        # backoff round, so this exercises the no-deadline path hang-free.
+        res = probe_mesh(
+            iface4["ip"], iface4["broadcast"], port, start_ms=100,
+            total_timeout_ms=0,
+        )
+        assert res is not None
     finally:
         for e in engines:
             e.stop()

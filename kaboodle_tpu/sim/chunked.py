@@ -93,6 +93,7 @@ def make_chunked_tick_fn(
     faulty: bool = True,
     block: int = 1024,
     drop: bool = True,
+    boot_union: bool = False,
 ) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
     """Build the row-blocked tick for a given config (see module docstring).
 
@@ -103,6 +104,24 @@ def make_chunked_tick_fn(
     The Pallas stage kernels and the fast/slow split do not apply here
     (this path is its own memory-bound formulation); every other config
     flag behaves exactly as in ``make_tick_fn``.
+
+    ``boot_union=True`` replaces the O(N^3) join-gossip contraction with
+    its closed form for the fresh broadcast-boot avalanche. PRECONDITION
+    (caller-owned, tested, NOT checked in-graph): a fault-free tick
+    (everyone alive, no drop/partition input) whose start-of-round
+    membership maps are exactly the singletons {self} — i.e. tick 0 of a
+    broadcast boot from ``init_state(ring_contacts=0)``. There,
+    ``member_a == eye`` collapses the share term to ``reply_del.T`` and
+    the joiner-prefix term to a reply-count comparison:
+
+        gossip[o, j] = reply_del[j, o]
+                     | (join_b[j] & (cnt[o] - reply_del[j, o] > 0) & (j <= o))
+
+    with ``cnt[o] = sum_r reply_del[r, o]`` — pure elementwise over the
+    reply transpose, no contraction. Bit-exact with the dense union on
+    that tick (tests/test_chunked.py pins it); on any other tick shape the
+    result is undefined. This is the union form the PERF.md north-star
+    projection budgets for the < 2 s avalanche on a v5e-8.
     """
 
     det = cfg.deterministic
@@ -582,6 +601,23 @@ def make_chunked_tick_fn(
                 return is_new & bern & ok_b
 
             reply_del = pmap_blocks(_reply_rows)
+
+            if boot_union:
+                # Closed-form avalanche union (see make_chunked_tick_fn
+                # docstring for the derivation and its precondition).
+                cnt = jnp.sum(reply_del.astype(jnp.int32), axis=0)  # [N(o)]
+
+                def _union_rows_boot(s0):
+                    gi = blk_idx(s0)
+                    repT = jax.lax.dynamic_slice_in_dim(
+                        reply_del, s0, block, axis=1).T  # [B(o), N(j)]
+                    others = (cnt[gi][:, None] - repT.astype(jnp.int32)) > 0
+                    tri = idx[None, :] <= gi[:, None]  # j <= o
+                    return repT | (join_b[None, :] & others & tri)
+
+                gossip = pmap_blocks(_union_rows_boot)
+                res = pmap_blocks(_make_compose(True, reply_del, gossip))
+                return res + (jnp.sum(reply_del, dtype=jnp.int32),)
 
             def _union_rows(s0):
                 # gossip[o, j] for joiner rows o: OR over responders r of

@@ -18,9 +18,16 @@ on this host's single core (it rides 8 MXUs on the real v5e-8); the
 revive/join machinery at scale is scale-proof-32k's job.
 
 Phases (PHASE lines bank incrementally; one final JSON line):
-1. converged-init state (lean+int16), asserted through the standalone
-   fingerprint-agreement check (parallel.sharded_convergence_check — the
-   same predicate, single-device here).
+1. Boot. ``--boot converged`` (default): everyone-knows-everyone init,
+   asserted through the standalone fingerprint-agreement check
+   (parallel.sharded_convergence_check — the same predicate,
+   single-device here). ``--boot broadcast``: the REAL join avalanche —
+   fresh singleton maps, every peer broadcasts Join at tick 0 — executed
+   through the chunked kernel's closed-form avalanche union
+   (``boot_union=True``, exact on precisely this tick shape; see
+   make_chunked_tick_fn), asserted converged. The closed form is what
+   makes this tick compute-feasible on a single core: the dense union is
+   ~2.8e14 int8-ops at N=65,536, the closed form is O(N^2) elementwise.
 2. ``--ticks`` faulty ticks, stepwise with donated carry: kills at tick 0
    (suspicion -> escalation -> indirect pings fire from tick
    ping_timeout+1 on), a partition window, manual pings each tick.
@@ -51,6 +58,10 @@ def main() -> None:
     p.add_argument("--ticks", type=int, default=4)
     p.add_argument("--kill-count", type=int, default=64)
     p.add_argument("--drop-rate", type=float, default=0.0)
+    p.add_argument("--boot", choices=["converged", "broadcast"],
+                   default="converged")
+    p.add_argument("--boot-max-ticks", type=int, default=4,
+                   help="broadcast boot: convergence budget (W3: ~1 tick)")
     args = p.parse_args()
 
     from axon_guard import strip_axon_plugin
@@ -78,18 +89,59 @@ def main() -> None:
         "state_variant": "lean+int16",
     }
 
-    # ---- phase 1: converged init, asserted -------------------------------
+    # ---- phase 1: boot, asserted -----------------------------------------
     t0 = time.perf_counter()
-    st = init_state(n, seed=0, ring_contacts=n - 1,
-                    track_latency=False, instant_identity=True,
-                    timer_dtype=jnp.int16)
-    conv, _, _, n_alive = sharded_convergence_check(st)
-    assert bool(conv) and int(n_alive) == n
-    line["boot"] = {
-        "mode": "converged",
-        "converged": True,
-        "wall_s": round(time.perf_counter() - t0, 3),
-    }
+    if args.boot == "converged":
+        st = init_state(n, seed=0, ring_contacts=n - 1,
+                        track_latency=False, instant_identity=True,
+                        timer_dtype=jnp.int16)
+        conv, _, _, n_alive = sharded_convergence_check(st)
+        assert bool(conv) and int(n_alive) == n
+        line["boot"] = {
+            "mode": "converged",
+            "converged": True,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+    else:
+        # The join avalanche itself: fresh singleton maps, everyone
+        # broadcasts Join at tick 0 (the boot_union precondition, which
+        # only holds for that first tick — later ticks of this loop bear
+        # no joins, so the special union branch never runs again).
+        from kaboodle_tpu.sim.state import idle_inputs
+
+        st = init_state(n, seed=0, ring_contacts=0,
+                        track_latency=False, instant_identity=True,
+                        timer_dtype=jnp.int16)
+        boot_cfg = SwimConfig()
+        boot_tick = jax.jit(
+            make_chunked_tick_fn(boot_cfg, faulty=False, block=block,
+                                 boot_union=True),
+            donate_argnums=0,
+        )
+        idle = idle_inputs(n)
+        boot_ticks = 0
+        conv = False
+        for _ in range(args.boot_max_ticks):
+            st, m = boot_tick(st, idle)
+            boot_ticks += 1
+            print("PHASE " + json.dumps({
+                "boot_tick": boot_ticks,
+                "messages_delivered": int(m.messages_delivered),
+                "converged": bool(m.converged),
+                "mean_membership": round(float(m.mean_membership), 1),
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "peak_rss_mib": _rss_mib(),
+            }), flush=True)
+            if bool(m.converged):
+                conv = True
+                break
+        assert conv, f"broadcast boot did not converge in {boot_ticks} ticks"
+        line["boot"] = {
+            "mode": "broadcast",
+            "ticks_to_convergence": boot_ticks,
+            "converged": True,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
     print("PHASE " + json.dumps({**line["boot"], "peak_rss_mib": _rss_mib()}),
           flush=True)
 

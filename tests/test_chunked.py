@@ -95,6 +95,37 @@ def test_chunked_parity_share_cap():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("det", [True, False])
+def test_chunked_boot_union_closed_form(det):
+    """boot_union=True (the closed-form avalanche union) against both the
+    dense chunked union and the whole-tensor kernel on its valid shape: a
+    fault-free broadcast boot from singleton maps, where tick 0 is the
+    only join-bearing tick. Random mode included: the Bernoulli streams
+    differ from the flagship kernel (D10) but must agree between the two
+    chunked builds, which share them — so the three-way check is dense
+    chunked == boot_union chunked (exact, both modes) and, in
+    deterministic mode, == make_tick_fn too."""
+    n, ticks = 48, 10
+    cfg = SwimConfig(deterministic=det)
+    st = init_state(n, seed=6)
+    inp = idle_inputs(n, ticks=ticks)
+    tick_d = jax.jit(make_chunked_tick_fn(cfg, faulty=False, block=16))
+    tick_b = jax.jit(make_chunked_tick_fn(cfg, faulty=False, block=16,
+                                          boot_union=True))
+    tick_k = jax.jit(make_tick_fn(cfg, faulty=False))
+    sd = sb = sk = st
+    for t in range(ticks):
+        it = jax.tree.map(lambda x: x[t], inp)
+        sd, md = tick_d(sd, it)
+        sb, mb = tick_b(sb, it)
+        _assert_leaves_equal((sd, md), (sb, mb), tick=t)
+        if det:
+            sk, mk = tick_k(sk, it)
+            _assert_leaves_equal((sk, mk), (sb, mb), tick=t)
+    assert bool(np.asarray(mb.converged))
+
+
+@pytest.mark.slow
 def test_chunked_parity_epidemic_boot():
     """Join broadcasts compiled out (gossip boot, fresh stamps): the
     chunked path with no join machinery at all."""

@@ -71,3 +71,35 @@ def test_random_scenario_random_flags(seed):
     st = init_state(n, seed=seed, ring_contacts=ring, timer_dtype=timer_dtype)
     mesh = LockstepMesh(n, cfg, seed=seed, ring_contacts=ring)
     _run_parity(mesh, st, _random_inputs(rng, n, TICKS), cfg=cfg)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_scenario_chunked_third_engine(seed):
+    """The chunked (row-blocked) kernel as a third arm of the same fuzz:
+    random scenarios x random flags, exact state equality with the
+    whole-tensor kernel every tick (which the fuzz above pins to the
+    oracle — so all three engines agree transitively)."""
+    import jax
+
+    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    rng = np.random.default_rng(2000 + seed)
+    n = 2 * int(rng.integers(5, 11))  # even, so block = n // 2 divides
+    cfg = _random_cfg(rng)
+    ring = int(rng.integers(1, 3)) if not cfg.join_broadcast_enabled else 0
+    timer_dtype = jnp.int16 if rng.integers(2) else jnp.int32
+    st = init_state(n, seed=seed, ring_contacts=ring, timer_dtype=timer_dtype)
+    tick_a = jax.jit(make_tick_fn(cfg, faulty=True))
+    tick_b = jax.jit(make_chunked_tick_fn(cfg, faulty=True, block=n // 2))
+    sa = sb = st
+    for t, inp in enumerate(_random_inputs(rng, n, TICKS)):
+        sa, ma = tick_a(sa, inp)
+        sb, mb = tick_b(sb, inp)
+        for x, y in zip(jax.tree.leaves((sa, ma)), jax.tree.leaves((sb, mb))):
+            xv, yv = np.asarray(x), np.asarray(y)
+            if xv.dtype == np.float32:
+                ok = ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+            else:
+                ok = (xv == yv).all()
+            assert ok, f"chunked mismatch at tick {t} (seed {seed})"

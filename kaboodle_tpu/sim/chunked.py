@@ -619,6 +619,12 @@ def make_chunked_tick_fn(
                 res = pmap_blocks(_make_compose(True, reply_del, gossip))
                 return res + (jnp.sum(reply_del, dtype=jnp.int32),)
 
+            # Gate the O(N^3) contraction on a reply actually existing (same
+            # rationale as kernel.py _join_replies: a rebroadcast into a
+            # full mesh yields zero new joiners, zero replies, and an
+            # all-False contraction that still costs the full dense time).
+            any_reply = jnp.any(reply_del)
+
             def _union_rows(s0):
                 # gossip[o, j] for joiner rows o: OR over responders r of
                 # reply_del[r, o] & (share_base[r, j] | (Jm[r, j] & j <= o)).
@@ -644,7 +650,11 @@ def make_chunked_tick_fn(
                 tri = idx[None, :] <= blk_idx(s0)[:, None]  # j <= o
                 return (t1 > 0) | ((t2 > 0) & tri)
 
-            gossip = pmap_blocks(_union_rows)
+            gossip = jax.lax.cond(
+                any_reply,
+                lambda: pmap_blocks(_union_rows),
+                lambda: jnp.zeros((n, n), dtype=bool),
+            )
             res = pmap_blocks(_make_compose(True, reply_del, gossip))
             return res + (jnp.sum(reply_del, dtype=jnp.int32),)
 

@@ -680,17 +680,33 @@ def make_tick_fn(
                 # joiners accepted with origin index <= o (the oracle's
                 # sequential processing order):
                 #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
-                share_base = member_a
-                if cfg.max_share_peers and n > cfg.max_share_peers:
-                    # D5: cap to lowest-index members of the start-of-round map.
-                    within_cap = (
-                        jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
-                    )
-                    share_base = member_a & within_cap
-                term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
-                term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
-                tri = idx[None, :] <= idx[:, None]  # j <= o
-                return reply_del_, term1 | (term2 & tri)
+                def _union():
+                    share_base = member_a
+                    if cfg.max_share_peers and n > cfg.max_share_peers:
+                        # D5: cap to lowest-index members of the start-of-round map.
+                        within_cap = (
+                            jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+                        )
+                        share_base = member_a & within_cap
+                    term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
+                    term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
+                    tri = idx[None, :] <= idx[:, None]  # j <= o
+                    return term1 | (term2 & tri)
+
+                # The O(N^3) union contracts reply_del: gate it on a reply
+                # actually existing, not merely on a Join broadcast — a
+                # rebroadcast into an already-full mesh (every survivor is
+                # lonely-flagged never_broadcast at a fresh converged init,
+                # and every revive re-announces) produces NO new joiners and
+                # so no replies, and the dense contraction on all-False
+                # operands was the dominant cost of exactly those ticks
+                # (the 8,610 s revive tick in SCALE_PROOF.md).
+                gossip_ = jax.lax.cond(
+                    jnp.any(reply_del_),
+                    _union,
+                    lambda: jnp.zeros((n, n), dtype=bool),
+                )
+                return reply_del_, gossip_
 
             if cfg.join_broadcast_enabled:
                 reply_del, gossip = jax.lax.cond(

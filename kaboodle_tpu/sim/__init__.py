@@ -2,6 +2,7 @@
 
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, init_state, idle_inputs
 from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
 from kaboodle_tpu.sim.runner import simulate, run_until_converged
 from kaboodle_tpu.sim.scenario import Scenario, baseline_scenario
 
@@ -12,6 +13,7 @@ __all__ = [
     "init_state",
     "idle_inputs",
     "make_tick_fn",
+    "make_chunked_tick_fn",
     "simulate",
     "run_until_converged",
     "Scenario",

@@ -106,9 +106,16 @@ def init_state(
     track_latency: bool = True,
     instant_identity: bool = False,
     timer_dtype=jnp.int32,
+    announced: bool = False,
 ) -> MeshState:
     """Fresh mesh: every peer knows only itself (kaboodle.rs:144-152) and will
     broadcast Join on its first active phase (kaboodle.rs:228-251).
+
+    ``announced=True`` clears the never-broadcast flags: the state models a
+    mesh that already announced itself — the right pairing for converged
+    inits (``ring_contacts=n-1``), where leaving the flags set would fire a
+    spurious all-N Join re-announce (zero new joiners) on the first tick
+    and skew steady-state measurements.
 
     ``ring_contacts=c`` additionally seeds peer i with Known entries for
     peers (i+1..i+c) mod n — out-of-band bootstrap contacts for the gossip
@@ -140,7 +147,8 @@ def init_state(
         timer=jnp.zeros((n, n), dtype=timer_dtype),
         alive=jnp.ones((n,), dtype=bool) if alive is None else alive,
         identity=identities,
-        never_broadcast=jnp.ones((n,), dtype=bool),
+        never_broadcast=jnp.zeros((n,), dtype=bool) if announced
+        else jnp.ones((n,), dtype=bool),
         last_broadcast=jnp.zeros((n,), dtype=jnp.int32),
         kpr_partner=jnp.full((n,), -1, dtype=jnp.int32),
         kpr_fp=jnp.zeros((n,), dtype=jnp.uint32),

@@ -92,17 +92,13 @@ def main() -> None:
     # ---- phase 1: boot, asserted -----------------------------------------
     t0 = time.perf_counter()
     if args.boot == "converged":
-        import dataclasses
-
+        # announced=True: a converged mesh has already broadcast itself —
+        # without it every peer re-announces Join at the first faulty tick
+        # (an all-N avalanche with zero new joiners; pure waste, and the
+        # old dense union made it the dominant cost of that tick).
         st = init_state(n, seed=0, ring_contacts=n - 1,
                         track_latency=False, instant_identity=True,
-                        timer_dtype=jnp.int16)
-        # A converged mesh has already announced itself: clear the
-        # never-broadcast flags or every peer re-broadcasts Join at the
-        # first faulty tick (an all-N join avalanche with zero new joiners
-        # — pure waste, and the old dense union made it the dominant cost).
-        st = dataclasses.replace(
-            st, never_broadcast=jnp.zeros((n,), dtype=bool))
+                        timer_dtype=jnp.int16, announced=True)
         conv, _, _, n_alive = sharded_convergence_check(st)
         assert bool(conv) and int(n_alive) == n
         line["boot"] = {

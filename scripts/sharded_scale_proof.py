@@ -135,9 +135,12 @@ def main() -> None:
         )
         ring = {"epidemic": 2, "broadcast": 0, "converged": n - 1}[args.boot]
         st0 = shard_state(
+            # announced on the converged init only: that state models an
+            # already-running mesh (see init_state docstring).
             init_state(n, seed=0, ring_contacts=ring,
                        track_latency=not lean, instant_identity=lean,
-                       timer_dtype=timer_dtype),
+                       timer_dtype=timer_dtype,
+                       announced=args.boot == "converged"),
             mesh,
         )
         t0 = time.perf_counter()

@@ -248,7 +248,8 @@ def probe_cuts(n, variant="fused_all"):
     # successive-diff decomposition is meaningless.
     cfg = SwimConfig(fast_path=False, **kw)
     st = init_state(n, seed=0, ring_contacts=n - 1, track_latency=False,
-                    instant_identity=True, timer_dtype=jnp.int16)
+                    instant_identity=True, timer_dtype=jnp.int16,
+                    announced=True)
     idle = idle_inputs(n)
 
     for cut in ("A", "c1", "c2", "c34", "G", None):

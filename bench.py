@@ -66,6 +66,18 @@ def _is_oom(e: Exception) -> bool:
             or "failed to allocate" in msg.lower())
 
 
+def _is_size_ceiling(e: Exception) -> bool:
+    """Size-induced failures that warrant stepping down to a smaller N:
+    memory exhaustion, or the tunnel's remote-compile-helper failure — every
+    N=32,768 whole-tick compile 500s through it (PERF.md "Ceilings"), and
+    that exception is not OOM-shaped, so without this the headline ladder's
+    first rung would kill the whole bench in a live window."""
+    msg = str(e)
+    return (_is_oom(e)
+            or "tpu_compile_helper" in msg
+            or ("compile" in msg.lower() and "500" in msg))
+
+
 def _newest_watch_entry(kind: str, valid=None):
     """Newest TPU_WATCH.log JSON line of the given kind (passing ``valid``
     if given), or None.
@@ -618,12 +630,13 @@ def main() -> None:
             used_n = n
             break
         except Exception as e:
-            # Step down only on memory exhaustion; anything else is a real
-            # bug and must surface as a traceback, not "all sizes failed".
-            if not _is_oom(e) or n == sizes[-1]:
+            # Step down only on size-induced ceilings (OOM / the tunnel's
+            # 32k compile-helper failure); anything else is a real bug and
+            # must surface as a traceback, not "all sizes failed".
+            if not _is_size_ceiling(e) or n == sizes[-1]:
                 raise
-            print(f"bench: N={n} OOM ({type(e).__name__}); stepping down",
-                  file=sys.stderr)
+            print(f"bench: N={n} size ceiling ({type(e).__name__}); "
+                  "stepping down", file=sys.stderr)
 
     # Gossip-boot convergence (the meaningful ticks-to-convergence metric:
     # the broadcast boot converges in 1 tick by construction, see W3). Sweep

@@ -1,0 +1,45 @@
+"""graftlint — the JAX/TPU-aware static analysis gate for this codebase.
+
+A dependency-free, rule-based AST analyzer that supersedes the old
+``scripts/lint.py`` (now a shim over this package) and understands the
+repo's jit/shard_map idioms. Rule families:
+
+- **KB1xx generic**: undefined names (KB101), unused imports (KB102),
+  mutable default args (KB103), shadowed builtins (KB104).
+- **KB2xx jax-tracer** (inside jit-traced code, per ``reach.py``): Python
+  branching on traced values (KB201), host coercions of tracers (KB202),
+  ``print`` in jit (KB203), PRNG key reuse (KB204), use-after-donation
+  (KB205).
+- **KB3xx hot-path** (``kaboodle_tpu/sim/`` + ``kaboodle_tpu/ops/``):
+  host syncs in the tick kernels (KB301), dtype-less ``jnp`` constructors
+  in the dtype-disciplined files (KB302).
+
+Suppression: per-line ``# noqa: KBnnn`` (bare ``# noqa`` and foreign-code
+lists suppress everything on the line), or a justified entry in the
+checked-in baseline ``.graftlint_baseline.json`` — see ``core.py``.
+
+CLI: ``python -m kaboodle_tpu.analysis [--explain KBnnn] [paths...]``;
+``make lint`` and CI run it with the default target set, and CI's
+``--no-baseline-growth`` step guarantees the baseline only shrinks.
+
+This module imports no jax: analysis is pure AST, so the lint lane and its
+tests run at parse speed with no accelerator backend.
+"""
+
+from kaboodle_tpu.analysis.core import (
+    Finding,
+    Module,
+    analyze_module,
+    analyze_path,
+    analyze_source,
+)
+from kaboodle_tpu.analysis.cli import main
+
+__all__ = [
+    "Finding",
+    "Module",
+    "analyze_module",
+    "analyze_path",
+    "analyze_source",
+    "main",
+]

@@ -1,0 +1,130 @@
+"""graftlint CLI: ``python -m kaboodle_tpu.analysis [options] [paths...]``.
+
+Exit codes: 0 clean (baselined findings allowed), 1 findings / baseline
+violations, 2 usage or baseline-format error.
+
+Modes:
+
+- default: report every finding whose key is not in the baseline.
+- ``--no-baseline-growth``: additionally fail on *stale* baseline entries
+  (keys that no longer match any finding). Together with the default mode
+  this makes the baseline monotonically shrinking: new findings can't land
+  (they fail the lint), and fixed findings force their entry's deletion.
+- ``--write-baseline``: regenerate the baseline from current findings,
+  preserving existing reasons; new entries get a TODO reason that the
+  loader will keep accepting but a reviewer should replace.
+- ``--explain KBnnn`` / ``--list-rules``: rule documentation.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+from kaboodle_tpu.analysis import core
+
+DEFAULT_TARGETS = [
+    "kaboodle_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py",
+]
+
+USAGE = """\
+usage: python -m kaboodle_tpu.analysis [options] [paths...]
+
+options:
+  --baseline PATH        baseline file (default: .graftlint_baseline.json)
+  --no-baseline          ignore the baseline entirely
+  --no-baseline-growth   also fail on stale baseline entries (CI debt gate)
+  --write-baseline       regenerate the baseline from current findings
+  --explain KBnnn        print one rule's documentation and exit
+  --list-rules           print every rule id + title and exit
+  -h, --help             this message
+"""
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    baseline_path = pathlib.Path(core.DEFAULT_BASELINE)
+    use_baseline = True
+    no_growth = False
+    write = False
+    targets: list[str] = []
+
+    core._load_rules()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(USAGE, end="")
+            return 0
+        if a == "--baseline":
+            i += 1
+            if i >= len(argv):
+                print("--baseline needs a path", file=sys.stderr)
+                return 2
+            baseline_path = pathlib.Path(argv[i])
+        elif a == "--no-baseline":
+            use_baseline = False
+        elif a == "--no-baseline-growth":
+            no_growth = True
+        elif a == "--write-baseline":
+            write = True
+        elif a == "--list-rules":
+            for rid in sorted(core.REGISTRY):
+                print(f"{rid}  {core.REGISTRY[rid].title}")
+            return 0
+        elif a == "--explain":
+            i += 1
+            rid = argv[i].upper() if i < len(argv) else ""
+            r = core.REGISTRY.get(rid)
+            if r is None:
+                print(f"unknown rule {rid!r}; try --list-rules", file=sys.stderr)
+                return 2
+            print(f"{r.id} — {r.title}\n\n{r.explain}")
+            return 0
+        elif a.startswith("-"):
+            print(f"unknown option {a!r}\n{USAGE}", file=sys.stderr, end="")
+            return 2
+        else:
+            targets.append(a)
+        i += 1
+
+    files = core.iter_python_files(targets or DEFAULT_TARGETS)
+    findings: list[core.Finding] = []
+    for f in files:
+        findings.extend(core.analyze_path(f))
+
+    try:
+        baseline = core.load_baseline(baseline_path) if use_baseline else {}
+    except core.BaselineError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if write:
+        core.write_baseline(baseline_path, findings, baseline)
+        print(
+            f"graftlint: wrote {baseline_path} with "
+            f"{len({x.key for x in findings})} entries",
+            file=sys.stderr,
+        )
+        return 0
+
+    active = [f for f in findings if f.key not in baseline]
+    suppressed = len(findings) - len(active)
+    for f in active:
+        print(f.render())
+
+    rc = 1 if active else 0
+    if no_growth:
+        live_keys = {f.key for f in findings}
+        stale = sorted(k for k in baseline if k not in live_keys)
+        for k in stale:
+            print(f"stale baseline entry (fixed? delete it): {k}")
+        if stale:
+            rc = 1
+
+    print(
+        f"graftlint: {len(files)} files, {len(active)} findings"
+        + (f" ({suppressed} baselined)" if suppressed else ""),
+        file=sys.stderr,
+    )
+    return rc

@@ -1,0 +1,330 @@
+"""Syntactic jit-reachability + tracer taint for the KB2xx/KB3xx rules.
+
+The JAX rules only make sense inside code that runs under tracing. Without
+importing jax, "traced" is decided per module from syntax:
+
+Seeds (a function is traced if any of these hold):
+
+- decorated with a trace wrapper: ``@jax.jit``, ``@jit``, ``@pjit``,
+  ``@shard_map``, or ``@(functools.)partial(<wrapper>, ...)``;
+- passed by name to a trace-wrapper call (``jax.jit(f)``, ``shard_map(f,
+  mesh, ...)``) or to a tracing callback site (``jax.lax.scan/cond/
+  while_loop/fori_loop/switch/map/associative_scan``, ``pl.pallas_call``,
+  ``jax.vmap/grad/checkpoint``, ``jax.tree.map`` — their callees all
+  receive tracers when the surrounding program is traced);
+- marked ``# graftlint: traced`` on its ``def`` line — the escape hatch for
+  functions that are traced from *another* module (e.g. the tick closures
+  returned by ``make_tick_fn`` and scanned by ``runner.simulate``), since
+  reachability is per-module by design;
+- defined inside, or called by name from, an already-traced function
+  (transitive closure over module-local names).
+
+Traced parameters: every parameter of a seed, minus names listed in a
+``static_argnames=(...)`` / ``static_argnums=(...)`` literal on the wrapper.
+Inside a traced function, taint then propagates forward through assignments,
+with two structural exemptions that keep idiomatic JAX clean:
+
+- attribute reads that are static under tracing (``.shape``, ``.dtype``,
+  ``.ndim``, ``.size``, ...) cut the taint — ``n = st.state.shape[-1]`` is
+  a Python int at trace time;
+- ``x is None`` / ``x is not None`` is a static structural test (pytrees
+  with optional leaves), not a value branch.
+
+This is deliberately an approximation: no cross-module reachability, no
+lambda bodies, loops don't re-run the taint pass. False negatives are
+acceptable (the gate tightens over time); false positives are the thing
+being engineered against, because a ``-D warnings`` gate that cries wolf
+gets noqa'd into uselessness.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kaboodle_tpu.analysis.core import Module
+
+TRACE_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "pjit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.sharding.shard_map",
+}
+
+CALLBACK_SITES = {
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.experimental.pallas.pallas_call",
+    "jax.tree.map",
+    "jax.tree_util.tree_map",
+}
+
+PARTIAL = {"functools.partial", "partial"}
+
+# Attribute reads that are static (Python values) on a traced array/pytree.
+STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "weak_type", "sharding",
+    "aval", "names",
+}
+
+TRACED_PRAGMA = "graftlint: traced"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    qualname: str
+    traced: bool = False
+    # Whether traced_params carries the full parameter list. Seeds and nested
+    # defs of traced functions get all params (minus static_arg* names);
+    # functions merely *called by name* from traced code run at trace time
+    # but receive unknown — often static — arguments, so they are marked
+    # traced with NO tainted params: their bodies still see the taint-free
+    # checks (print, host sync) but never a spurious traced-branch finding.
+    params_full: bool = False
+    traced_params: set[str] = dataclasses.field(default_factory=set)
+
+
+def _param_names(node) -> list[str]:
+    a = node.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)] + (
+        [a.vararg.arg] if a.vararg else []
+    ) + ([a.kwarg.arg] if a.kwarg else [])
+
+
+def _static_names_from_call(mod: Module, call: ast.Call, params: list[str]) -> set[str]:
+    """Params named static via static_argnames/static_argnums literals."""
+    static: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    static.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    if 0 <= c.value < len(params):
+                        static.add(params[c.value])
+    return static
+
+
+class ReachInfo:
+    """Per-module map: function node -> FuncInfo with traced flags."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.by_node: dict[ast.AST, FuncInfo] = {}
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        self._collect(mod.tree, "")
+        self._seed()
+        self._closure()
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = FuncInfo(child, qual)
+                self.by_node[child] = info
+                self.by_name.setdefault(child.name, []).append(info)
+                self._collect(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, f"{prefix}{child.name}.")
+            else:
+                self._collect(child, prefix)
+
+    # -- seeding ------------------------------------------------------------
+
+    def _wrapper_call(self, call: ast.Call) -> str | None:
+        """The trace-wrapper name if ``call`` is jit-like (incl. partial)."""
+        d = self.mod.dotted(call.func)
+        if d in TRACE_WRAPPERS:
+            return d
+        if d in PARTIAL and call.args:
+            inner = self.mod.dotted(call.args[0])
+            if inner in TRACE_WRAPPERS:
+                return inner
+        return None
+
+    def _mark(
+        self, info: FuncInfo, static: set[str] | None = None, full: bool = True
+    ) -> None:
+        info.traced = True
+        if full and not info.params_full:
+            info.params_full = True
+            info.traced_params = {
+                p for p in _param_names(info.node) if p not in (static or set())
+            }
+
+    def _seed(self) -> None:
+        mod = self.mod
+        # decorators + pragma
+        for info in self.by_node.values():
+            node = info.node
+            line = mod.lines[node.lineno - 1] if node.lineno <= len(mod.lines) else ""
+            if TRACED_PRAGMA in line:
+                self._mark(info)
+                continue
+            params = _param_names(node)
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    w = self._wrapper_call(dec)
+                    if w:
+                        self._mark(info, _static_names_from_call(mod, dec, params))
+                elif mod.dotted(dec) in TRACE_WRAPPERS:
+                    self._mark(info)
+        # call sites: jax.jit(f, ...), lax.scan(f, ...), partial(jax.jit,...)(f)
+        for call in (n for n in ast.walk(mod.tree) if isinstance(n, ast.Call)):
+            d = mod.dotted(call.func)
+            names: list[str] = []
+            static: set[str] = set()
+            if self._wrapper_call(call) and call.args and isinstance(call.args[0], ast.Name):
+                names = [call.args[0].id]
+                # static names on the wrapper apply to the wrapped function
+                for info in self.by_name.get(names[0], []):
+                    static = _static_names_from_call(mod, call, _param_names(info.node))
+            elif d in CALLBACK_SITES:
+                names = [a.id for a in call.args if isinstance(a, ast.Name)]
+            for name in names:
+                for info in self.by_name.get(name, []):
+                    if not info.traced:
+                        self._mark(info, static)
+
+    def _closure(self) -> None:
+        """Nested defs of traced fns (full params) + local fns *called* from
+        traced fns (traced, but no tainted params — see FuncInfo)."""
+        changed = True
+        while changed:
+            changed = False
+            for info in list(self.by_node.values()):
+                if not info.traced:
+                    continue
+                for sub in ast.walk(info.node):
+                    if sub is info.node:
+                        continue
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        target = self.by_node.get(sub)
+                        if target is not None and not (
+                            target.traced and target.params_full
+                        ):
+                            self._mark(target, full=True)
+                            changed = True
+                    elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        cands = self.by_name.get(sub.func.id, [])
+                        target = cands[0] if len(cands) == 1 else None
+                        if target is not None and not target.traced:
+                            self._mark(target, full=False)
+                            changed = True
+
+    # -- public -------------------------------------------------------------
+
+    def traced_functions(self) -> list[FuncInfo]:
+        return [i for i in self.by_node.values() if i.traced]
+
+
+# ---------------------------------------------------------------------------
+# taint
+
+
+def expr_tainted(e: ast.AST, tainted: set[str]) -> bool:
+    """Does ``e``'s value (under tracing) depend on a tainted name?
+
+    Prunes static-attribute reads and ``is (not) None`` tests — see module
+    docstring.
+    """
+    if isinstance(e, ast.Name):
+        return e.id in tainted
+    if isinstance(e, ast.Attribute):
+        return False if e.attr in STATIC_ATTRS else expr_tainted(e.value, tainted)
+    if isinstance(e, ast.Compare):
+        if (
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops)
+            and all(
+                isinstance(c, ast.Constant) and c.value is None for c in e.comparators
+            )
+        ):
+            return False
+        return expr_tainted(e.left, tainted) or any(
+            expr_tainted(c, tainted) for c in e.comparators
+        )
+    if isinstance(e, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+        return False
+    return any(expr_tainted(c, tainted) for c in ast.iter_child_nodes(e))
+
+
+def _assign_targets(t: ast.AST) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for e in t.elts:
+            out.extend(_assign_targets(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _assign_targets(t.value)
+    return []
+
+
+def shallow_exprs(stmt: ast.stmt) -> list[ast.expr]:
+    """The expressions belonging directly to ``stmt`` — not the ones inside
+    its nested statement blocks (those get their own visit)."""
+    out: list[ast.expr] = []
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers", "decorator_list"):
+            continue
+        if isinstance(value, ast.expr):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.expr))
+        elif isinstance(value, ast.withitem):
+            out.append(value.context_expr)
+    # `with a as b, c as d:` — items is a list of withitems
+    for item in getattr(stmt, "items", []) or []:
+        if isinstance(item, ast.withitem):
+            out.append(item.context_expr)
+    return out
+
+
+def walk_with_taint(info: FuncInfo, visit) -> None:
+    """Forward walk of a traced function's own body (nested defs skipped —
+    they are separate traced entries) maintaining the tainted-name set.
+    ``visit(stmt, tainted)`` is called per statement, pre-propagation."""
+    tainted = set(info.traced_params)
+
+    def do(stmts) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            visit(s, tainted)
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)) and getattr(
+                s, "value", None
+            ) is not None:
+                if expr_tainted(s.value, tainted):
+                    targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+                    for t in targets:
+                        tainted.update(_assign_targets(t))
+            elif isinstance(s, ast.For):
+                if expr_tainted(s.iter, tainted):
+                    tainted.update(_assign_targets(s.target))
+            # walk nested blocks in order
+            for field in ("body", "orelse", "finalbody"):
+                do(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                do(h.body)
+
+    do(info.node.body)

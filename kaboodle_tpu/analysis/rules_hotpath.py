@@ -1,0 +1,135 @@
+"""KB3xx — hot-path rules, scoped to the tick-kernel stack.
+
+These rules only fire in ``kaboodle_tpu/sim/`` and ``kaboodle_tpu/ops/``
+(matched on the module path): the whole-tensor and chunked tick kernels,
+their fused Pallas stages, and the sampling/hashing primitives they call.
+That is the code whose per-tick cost the north-star budget (ROADMAP.md:
+65,536 peers converging in <2s on a v5e-8) is spent on — a stray host
+sync or an accidental int64 promotion there costs more than any
+micro-optimization wins.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kaboodle_tpu.analysis.core import Finding, Module, rule
+from kaboodle_tpu.analysis.reach import shallow_exprs, walk_with_taint
+
+HOT_DIRS = ("kaboodle_tpu/sim/", "kaboodle_tpu/ops/")
+
+# Files whose tensors carry the int8/int16/int32/uint32 discipline the
+# MEMORY_PLAN/SEMANTICS docs commit to: the CRC/mix-hash paths (wrong dtype =
+# wrong fingerprint) and the state/timer/sampling paths (implicit defaults
+# promote, silently doubling the [N, N] residents or wrapping sentinels).
+DTYPE_DISCIPLINE_FILES = (
+    "crc32.py", "hashing.py", "kernel.py", "chunked.py", "state.py", "sampling.py",
+)
+
+_CONSTRUCTORS = {
+    # name -> number of positional args at which dtype is already supplied
+    "jax.numpy.zeros": 2,
+    "jax.numpy.ones": 2,
+    "jax.numpy.empty": 2,
+    "jax.numpy.full": 3,
+    "jax.numpy.arange": 4,
+}
+
+
+def _in_hot_dirs(mod: Module) -> bool:
+    return any(d in mod.path for d in HOT_DIRS)
+
+
+@rule(
+    "KB301",
+    "host sync in the tick hot path",
+    """
+Inside jit-traced code under `kaboodle_tpu/sim/` or `kaboodle_tpu/ops/`:
+`jax.device_get(...)`, `.block_until_ready()`, or a host `numpy` call.
+Each one forces a device->host round trip (or a trace-time concretization)
+in the code that must execute as one fused XLA program per tick — at
+N=65,536 a single stray sync outweighs every fused-kernel win recorded in
+PERF.md. Hoist host work out of the kernel, or keep it in `jnp`.
+Trace-time-static numpy (table building at module import) is outside
+traced functions and does not fire.
+""",
+)
+def check_hot_host_sync(mod: Module) -> list[Finding]:
+    if not _in_hot_dirs(mod):
+        return []
+    out: list[Finding] = []
+    for info in mod.reach.traced_functions():
+
+        def visit(stmt, tainted, info=info):
+            for expr in shallow_exprs(stmt):
+                for node in ast.walk(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hit = None
+                    d = mod.dotted(node.func)
+                    if d == "jax.device_get":
+                        hit = "jax.device_get()"
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"
+                    ):
+                        hit = ".block_until_ready()"
+                    elif d and d.startswith("numpy."):
+                        hit = d.replace("numpy.", "np.") + "()"
+                    if hit:
+                        out.append(
+                            Finding(
+                                mod.path, "KB301", node.lineno,
+                                f"{hit} inside jit-traced '{info.qualname}' "
+                                "(tick hot path)",
+                                f"{info.qualname}.{hit}",
+                            )
+                        )
+
+        walk_with_taint(info, visit)
+    return out
+
+
+@rule(
+    "KB302",
+    "dtype-less jnp constructor in a dtype-disciplined file",
+    """
+`jnp.zeros/ones/full/empty/arange` without an explicit dtype in one of the
+int-discipline files (crc32/hashing/kernel/chunked/state/sampling). The
+implicit default there is a trap twice over: `arange`/`zeros` default to
+the x64-flag-dependent int/float, and a bare Python scalar promotes the
+whole [N, N] expression (the int16-timer carry bug class documented in
+kernel.py — "a bare `t` in a where() would promote the whole tensor").
+The crc32/mix-hash paths additionally *require* uint32 wraparound; a
+defaulted int32 there changes fingerprints. Spell the dtype.
+""",
+)
+def check_dtypeless_constructor(mod: Module) -> list[Finding]:
+    if not (
+        _in_hot_dirs(mod) and mod.path.rsplit("/", 1)[-1] in DTYPE_DISCIPLINE_FILES
+    ):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = mod.dotted(node.func)
+        if d not in _CONSTRUCTORS:
+            continue
+        has_dtype = any(kw.arg == "dtype" for kw in node.keywords) or len(
+            node.args
+        ) >= _CONSTRUCTORS[d]
+        if not has_dtype:
+            ctor = d.rsplit(".", 1)[-1]
+            # Symbol is the constructor name, not the line: one baseline
+            # entry covers every instance of that constructor in the file
+            # (and stays valid across unrelated edits).
+            out.append(
+                Finding(
+                    mod.path, "KB302", node.lineno,
+                    f"jnp.{ctor}(...) without an explicit dtype in a "
+                    "dtype-disciplined file",
+                    f"jnp.{ctor}",
+                )
+            )
+    return out

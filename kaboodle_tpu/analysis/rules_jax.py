@@ -1,0 +1,470 @@
+"""KB2xx — jax-tracer rules.
+
+These run only inside functions the per-module reachability pass
+(``reach.py``) marks as traced — decorated/wrapped with jit/shard_map,
+passed to a ``lax`` control-flow or ``pallas_call`` site, marked
+``# graftlint: traced``, or reachable from one of those. KB204/KB205
+(key reuse, donation) are call-protocol rules and run in *every* function:
+a key reused in host code corrupts statistics just as surely.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kaboodle_tpu.analysis.core import Finding, Module, rule
+from kaboodle_tpu.analysis.reach import (
+    FuncInfo,
+    _assign_targets,
+    expr_tainted,
+    shallow_exprs,
+    walk_with_taint,
+)
+
+_COERCERS = {"float", "int", "bool", "complex"}
+_ITEM_ATTRS = {"item", "tolist"}
+
+# jax.random functions that *transform* keys rather than consume them.
+_KEY_PRODUCERS = {
+    "jax.random.key",
+    "jax.random.PRNGKey",
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+    "jax.random.wrap_key_data",
+}
+_KEY_NEUTRAL = _KEY_PRODUCERS | {"jax.random.key_data", "jax.random.key_impl"}
+
+
+def _finding(mod, rule_id, node, msg, symbol):
+    return Finding(mod.path, rule_id, node.lineno, msg, symbol)
+
+
+# ---------------------------------------------------------------------------
+# KB201 — Python control flow on traced values
+
+
+@rule(
+    "KB201",
+    "Python branch on a traced value",
+    """
+Inside jit-traced code, a Python `if`/`while`/`assert` whose condition
+depends on a traced value. Under tracing this either raises
+TracerBoolConversionError or — worse, with weak typing — silently burns
+one branch into the compiled program (a host sync + wrong semantics).
+Use `jax.lax.cond` / `jnp.where` / checkify instead. Structural tests
+(`x is None`, `.shape`/`.dtype`/`.ndim` reads) are static at trace time
+and exempt. A deliberate trace-time specialization on an argument that is
+static *by contract* belongs in the baseline with that contract as the
+reason, or under `# noqa: KB201`.
+""",
+)
+def check_traced_branch(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+
+    def visit_factory(info: FuncInfo):
+        def visit(stmt, tainted):
+            kind = None
+            if isinstance(stmt, (ast.If, ast.While)):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                test = stmt.test
+            elif isinstance(stmt, ast.Assert):
+                kind, test = "assert", stmt.test
+            if kind and expr_tainted(test, tainted):
+                # The tainted names participate in the symbol so a baselined
+                # `if deterministic:` cannot mask a later `if prob > 0.5:`
+                # added to the same function — distinct conditions get
+                # distinct baseline keys.
+                names = sorted(
+                    {
+                        n.id
+                        for n in ast.walk(test)
+                        if isinstance(n, ast.Name) and n.id in tainted
+                    }
+                )
+                out.append(
+                    _finding(
+                        mod, "KB201", stmt,
+                        f"Python `{kind}` on a traced value inside jit-traced "
+                        f"'{info.qualname}' — use lax.cond/jnp.where",
+                        f"{info.qualname}.{kind}({','.join(names)})",
+                    )
+                )
+
+        return visit
+
+    for info in mod.reach.traced_functions():
+        walk_with_taint(info, visit_factory(info))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KB202 — host coercions on traced values
+
+
+@rule(
+    "KB202",
+    "host coercion of a traced value",
+    """
+Inside jit-traced code, `float()`/`int()`/`bool()`/`complex()`, `.item()`,
+`.tolist()`, or a host `numpy` call applied to a traced value. These force
+a concrete value at trace time: TracerArrayConversionError at best, a
+silent device->host sync baked into every call at worst (the regression
+`tests/test_sampling.py::test_choose_one_of_oldest_k_traces_under_jit`
+pins one real instance). Keep the computation in `jnp`, or hoist the
+coercion out of the traced region. Static reads (`int(x.shape[0])`) are
+exempt.
+""",
+)
+def check_tracer_coercion(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+
+    def visit_factory(info: FuncInfo):
+        def visit(stmt, tainted):
+            for expr in shallow_exprs(stmt):
+                for node in ast.walk(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    args = [*node.args, *(kw.value for kw in node.keywords)]
+                    hit = None
+                    if (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id in _COERCERS
+                        and any(expr_tainted(a, tainted) for a in args)
+                    ):
+                        hit = f"{node.func.id}()"
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _ITEM_ATTRS
+                        and expr_tainted(node.func.value, tainted)
+                    ):
+                        hit = f".{node.func.attr}()"
+                    else:
+                        d = mod.dotted(node.func)
+                        if (
+                            d
+                            and d.startswith("numpy.")
+                            and any(expr_tainted(a, tainted) for a in args)
+                        ):
+                            hit = d.replace("numpy.", "np.") + "()"
+                    if hit:
+                        out.append(
+                            _finding(
+                                mod, "KB202", node,
+                                f"{hit} on a traced value inside jit-traced "
+                                f"'{info.qualname}'",
+                                f"{info.qualname}.{hit}",
+                            )
+                        )
+
+        return visit
+
+    for info in mod.reach.traced_functions():
+        walk_with_taint(info, visit_factory(info))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KB203 — print inside traced code
+
+
+@rule(
+    "KB203",
+    "print() inside jit-traced code",
+    """
+A bare `print` inside jit-traced code executes once at trace time with
+abstract values — it does not print per step, and what it does print is
+`Traced<...>` noise. Use `jax.debug.print` for runtime values, or move
+the print outside the traced region. A deliberate trace-time diagnostic
+can be suppressed with `# noqa: KB203`.
+""",
+)
+def check_print_in_jit(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    for info in mod.reach.traced_functions():
+
+        def visit(stmt, tainted, info=info):
+            for expr in shallow_exprs(stmt):
+                for node in ast.walk(expr):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                    ):
+                        out.append(
+                            _finding(
+                                mod, "KB203", node,
+                                f"print() inside jit-traced '{info.qualname}' "
+                                "— use jax.debug.print",
+                                f"{info.qualname}.print",
+                            )
+                        )
+
+        walk_with_taint(info, visit)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KB204 — PRNG key reuse
+
+
+class _PathWalker:
+    """Statement walk tracking a branch path: two events conflict only when
+    one path is a prefix of the other (same execution path) — uses in
+    sibling `if`/`else` arms never execute together and don't conflict."""
+
+    def __init__(self):
+        self.path: tuple = ()
+
+    def compatible(self, a: tuple, b: tuple) -> bool:
+        return a[: len(b)] == b or b[: len(a)] == a
+
+    def walk(self, stmts, on_stmt):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            on_stmt(s)
+            branches = [("body", s.body)] if hasattr(s, "body") else []
+            if getattr(s, "orelse", None):
+                branches.append(("orelse", s.orelse))
+            if getattr(s, "finalbody", None):
+                branches.append(("finalbody", s.finalbody))
+            for h in getattr(s, "handlers", []) or []:
+                # each except arm is its own exclusive path (keyed on the
+                # handler node, so sibling handlers never "share" a path)
+                branches.append((("handler", id(h)), h.body))
+            for label, body in branches:
+                if not isinstance(body, list):
+                    continue
+                base = self.path
+                # if/else arms and except arms exclude each other; loop/with/
+                # try bodies are on the parent's path.
+                excl = isinstance(s, ast.If) or (
+                    isinstance(label, tuple) and label[0] == "handler"
+                )
+                self.path = base + ((id(s), label),) if excl else base
+                self.walk(body, on_stmt)
+                self.path = base
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@rule(
+    "KB204",
+    "PRNG key reused",
+    """
+The same PRNG key name is passed to two different consuming calls on one
+execution path with no `jax.random.split`/rebind in between. The draws are
+then perfectly correlated — the classic silent JAX statistics bug. Key
+provenance is tracked syntactically: names bound from `jax.random.key/
+PRNGKey/split/fold_in` (and those inherited from the enclosing function)
+count as keys; passing one as an argument to anything except the key
+transformers consumes it. Parity tests that *want* identical draws across
+two implementations are the legitimate exception — baseline them with
+that justification.
+""",
+)
+def check_key_reuse(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+
+    def scan(func_node, qualname: str, inherited: frozenset[str]) -> None:
+        keys: set[str] = set(inherited)
+        uses: dict[str, list[tuple]] = {}
+        flagged: set[tuple[str, int]] = set()
+        pw = _PathWalker()
+
+        def handle_call(node: ast.Call) -> None:
+            d = mod.dotted(node.func)
+            args = [*node.args, *(kw.value for kw in node.keywords)]
+            if d in _KEY_NEUTRAL:
+                return
+            for a in args:
+                if isinstance(a, ast.Name) and a.id in keys:
+                    prior = uses.setdefault(a.id, [])
+                    if any(pw.compatible(p, pw.path) for p in prior):
+                        if (a.id, node.lineno) not in flagged:
+                            flagged.add((a.id, node.lineno))
+                            out.append(
+                                _finding(
+                                    mod, "KB204", node,
+                                    f"PRNG key '{a.id}' reused without an "
+                                    f"intervening split in '{qualname}'",
+                                    f"{qualname}.{a.id}",
+                                )
+                            )
+                    prior.append(pw.path)
+
+        def on_stmt(s: ast.stmt) -> None:
+            for expr in shallow_exprs(s):
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call):
+                        handle_call(node)
+            # (re)bindings after the loads of this statement
+            if isinstance(s, (ast.Assign, ast.AnnAssign)):
+                targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+                produced = isinstance(s.value, ast.Call) and (
+                    mod.dotted(s.value.func) in _KEY_PRODUCERS
+                )
+                for t in targets:
+                    for name in _target_names(t):
+                        uses.pop(name, None)
+                        if produced:
+                            keys.add(name)
+                        else:
+                            keys.discard(name)
+
+        pw.walk(func_node.body, on_stmt)
+        # nested functions: inherit this scope's key names
+        for child in ast.iter_child_nodes(func_node):
+            _scan_nested(child, qualname, frozenset(keys))
+
+    def _scan_nested(node, prefix, inherited):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(node, f"{prefix}.{node.name}", inherited)
+            return  # scan() recurses into its own children
+        for child in ast.iter_child_nodes(node):
+            _scan_nested(child, prefix, inherited)
+
+    # module level + every top-level function (nested handled recursively);
+    # the Module node itself plays the role of the outermost "function".
+    scan(mod.tree, "<module>", frozenset())
+    return out
+
+
+# assignment-target flattening is shared with the taint pass
+_target_names = _assign_targets
+
+
+# ---------------------------------------------------------------------------
+# KB205 — use after donation
+
+
+def _donated_positions(mod: Module, call: ast.Call) -> list[int] | None:
+    """Donated argument positions if ``call`` is a jit-with-donation wrapper
+    call. ``donate_argnums`` int literals map directly; ``donate_argnames``
+    string literals are resolved to positions through the wrapped function's
+    def when it is a module-local name (unresolvable names are skipped
+    rather than silently claiming coverage)."""
+    d = mod.dotted(call.func)
+    if d not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return None
+    nums: list[int] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums.extend(
+                c.value
+                for c in ast.walk(kw.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, int)
+            )
+        elif kw.arg == "donate_argnames":
+            names = [
+                c.value
+                for c in ast.walk(kw.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            ]
+            if names and call.args and isinstance(call.args[0], ast.Name):
+                wrapped = call.args[0].id
+                for fn in ast.walk(mod.tree):
+                    if (
+                        isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and fn.name == wrapped
+                    ):
+                        params = [
+                            p.arg for p in (*fn.args.posonlyargs, *fn.args.args)
+                        ]
+                        nums.extend(
+                            params.index(n) for n in names if n in params
+                        )
+                        break
+    return sorted(set(nums)) or None
+
+
+@rule(
+    "KB205",
+    "donated argument used after donation",
+    """
+A buffer passed at a donated position of a `jax.jit(...,
+donate_argnums=...)` function is read again afterwards. Donation hands the
+input buffer to XLA for reuse — on TPU the old array is *deleted* and any
+later use raises (or, on backends that ignore donation, silently works in
+dev and dies in prod). Rebind the result over the donated name
+(`st, m = tick(st, inp)`) or drop the donation. Tracked syntactically per
+function: `f = jax.jit(g, donate_argnums=0)` call sites of `f` mark the
+positional arg name donated until it is rebound.
+""",
+)
+def check_use_after_donation(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+
+    # names bound to donating jitted callables, module- or function-scope
+    donators: dict[str, list[int]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(mod, node.value)
+            if pos:
+                for t in node.targets:
+                    for name in _target_names(t):
+                        donators[name] = pos
+    if not donators:
+        return out
+
+    def scan(func_body, qualname: str) -> None:
+        donated: dict[str, tuple] = {}  # var name -> path at donation
+        pw = _PathWalker()
+
+        def on_stmt(s: ast.stmt) -> None:
+            exprs = shallow_exprs(s)
+            # 1. loads of already-donated names (excluding rebinding targets)
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if (
+                        isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in donated
+                        and pw.compatible(donated[node.id], pw.path)
+                    ):
+                        # the donating call itself re-donating is also a bug,
+                        # but a load inside the original donating statement
+                        # was already cleared below before registering.
+                        out.append(
+                            _finding(
+                                mod, "KB205", node,
+                                f"'{node.id}' used after being donated to a "
+                                f"jit-donated call in '{qualname}'",
+                                f"{qualname}.{node.id}",
+                            )
+                        )
+                        del donated[node.id]
+            # 2. new donations from calls in this statement
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                        pos = donators.get(node.func.id)
+                        if not pos:
+                            continue
+                        for i in pos:
+                            if i < len(node.args) and isinstance(
+                                node.args[i], ast.Name
+                            ):
+                                donated[node.args[i].id] = pw.path
+            # 3. rebindings clear donation
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.For)):
+                targets = (
+                    s.targets
+                    if isinstance(s, ast.Assign)
+                    else [s.target]
+                )
+                for t in targets:
+                    for name in _target_names(t):
+                        donated.pop(name, None)
+
+        pw.walk(func_body, on_stmt)
+
+    scan(mod.tree.body, "<module>")
+    for node in _functions(mod.tree):
+        scan(node.body, node.name)
+    return out

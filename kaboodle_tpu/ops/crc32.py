@@ -139,5 +139,5 @@ def membership_crc32(member: jax.Array, identities: jax.Array) -> jax.Array:
         return crc32_update_bytes(state, jnp.broadcast_to(recs[j], (n, 8)), mask), None
 
     init = jnp.full((n,), 0xFFFFFFFF, dtype=jnp.uint32)
-    out, _ = jax.lax.scan(step, init, jnp.arange(n))
+    out, _ = jax.lax.scan(step, init, jnp.arange(n, dtype=jnp.int32))
     return out ^ jnp.uint32(0xFFFFFFFF)

@@ -15,6 +15,15 @@ Exact ChaCha sequence parity is a non-goal (SURVEY.md §7); distributional
 parity is tested in tests/test_sampling.py. Every op has a ``deterministic``
 mode (lowest-index / always-true) used for exact oracle-vs-kernel trajectory
 tests.
+
+Static-by-contract flags: ``deterministic`` (and ``method``) select which
+program gets traced — callers always pass Python bools/strings (the tick
+kernels bake ``cfg.deterministic`` in at build time), never tracers. The
+``# graftlint: traced`` pragmas below keep the KB2xx tracer rules live on
+these functions (they are traced from kernel.py/chunked.py, which
+per-module reachability cannot see); the resulting KB201 findings on the
+specialization branches are baselined with exactly this contract as the
+justification (.graftlint_baseline.json).
 """
 
 from __future__ import annotations
@@ -23,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _stable_k_smallest_topk(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:
+def _stable_k_smallest_topk(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:  # graftlint: traced
     """(idx, valid) of the k smallest scores per row via sort-based top_k.
 
     Negation overflows at the dtype minimum (-(-32768) == -32768 in int16),
@@ -38,7 +47,7 @@ def _stable_k_smallest_topk(scores: jax.Array, k: int, tmax) -> tuple[jax.Array,
     return idx.astype(jnp.int32), neg_vals != -jnp.asarray(tmax, wide.dtype)
 
 
-def _stable_k_smallest_iter(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:
+def _stable_k_smallest_iter(scores: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array]:  # graftlint: traced
     """(idx, valid) of the k smallest scores per row, ties toward lower index.
 
     k rounds of lexicographic min-reduction over (score, index): round r
@@ -71,7 +80,7 @@ def _stable_k_smallest_iter(scores: jax.Array, k: int, tmax) -> tuple[jax.Array,
     return idx, jnp.stack(out_v, axis=-1)
 
 
-def choose_one_of_oldest_k(
+def choose_one_of_oldest_k(  # graftlint: traced
     timer: jax.Array,
     eligible: jax.Array,
     k: int,
@@ -114,7 +123,7 @@ def choose_one_of_oldest_k(
     return choose_among_candidates(idx, valid, key, deterministic)
 
 
-def choose_among_candidates(
+def choose_among_candidates(  # graftlint: traced
     idx: jax.Array,
     valid: jax.Array,
     key: jax.Array,
@@ -137,7 +146,7 @@ def choose_among_candidates(
     return jnp.where(count > 0, chosen, -1).astype(jnp.int32)
 
 
-def choose_k_members(
+def choose_k_members(  # graftlint: traced
     eligible: jax.Array,
     k: int,
     key: jax.Array,
@@ -168,7 +177,7 @@ def choose_k_members(
     return idx.astype(jnp.int32), valid
 
 
-def bernoulli_matrix(
+def bernoulli_matrix(  # graftlint: traced
     key: jax.Array,
     prob: jax.Array,
     shape: tuple[int, ...],
@@ -186,7 +195,7 @@ def bernoulli_matrix(
     return u < jnp.broadcast_to(prob, shape)
 
 
-def broadcast_reply_prob(num_known: jax.Array) -> jax.Array:
+def broadcast_reply_prob(num_known: jax.Array) -> jax.Array:  # graftlint: traced
     """The reply-dampening curve ``max(1, 100 - n^2)/100`` with ``n = len - 2``.
 
     ``num_known`` is the receiver's membership-map size *including itself*

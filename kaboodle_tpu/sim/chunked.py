@@ -126,7 +126,9 @@ def make_chunked_tick_fn(
 
     det = cfg.deterministic
 
-    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:
+    # Traced from other modules (jit call sites in the scale-proof scripts
+    # and tests) — same pragma rationale as kernel.py's tick.
+    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:  # graftlint: traced
         n = st.state.shape[-1]
         if n % block != 0:
             raise ValueError(f"block {block} does not divide N={n}")
@@ -786,7 +788,7 @@ def make_chunked_tick_fn(
         m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
             n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
         )
-        prio_proxy = jnp.full((n,), INF).at[jnp.clip(proxies, 0)].min(
+        prio_proxy = jnp.full((n,), INF, dtype=jnp.int32).at[jnp.clip(proxies, 0)].min(
             jnp.where(m_px, base2 + jstar[:, None], INF)
         )
         peer_proxy = prio_proxy - base2

@@ -152,7 +152,11 @@ def make_tick_fn(
         # stage probe would bank a full-tick time as a phase-cut measurement.
         raise ValueError(f"unknown _cut label {_cut!r}")
 
-    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:
+    # The closure is traced from ANOTHER module (runner.simulate's lax.scan /
+    # the jax.jit call sites in tests and scripts), which per-module
+    # reachability cannot see — the pragma keeps the KB2xx tracer rules live
+    # on the hottest function in the repo.
+    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:  # graftlint: traced
         n = st.state.shape[-1]
         t = st.tick
         idx = jnp.arange(n, dtype=jnp.int32)
@@ -891,7 +895,7 @@ def make_tick_fn(
             m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
                 n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
             )
-            prio_proxy = jnp.full((n,), INF).at[jnp.clip(proxies, 0)].min(
+            prio_proxy = jnp.full((n,), INF, dtype=jnp.int32).at[jnp.clip(proxies, 0)].min(
                 jnp.where(m_px, base2 + jstar[:, None], INF)
             )
             peer_proxy = prio_proxy - base2  # sender == X == candidate peer

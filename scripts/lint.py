@@ -1,136 +1,13 @@
-"""Minimal static lint gate — the CI clippy/fmt analogue (main.yml:48-52).
+"""Shim: the lint gate moved to graftlint (python -m kaboodle_tpu.analysis).
 
-The build environment ships no ruff/flake8/pyflakes and installs are not
-allowed, so this is a dependency-free AST checker for the two classes of
-defect that static analysis catches cheaply and that have actually bitten
-this repo:
-
-- **undefined names** (a module-level reference to a deleted/renamed
-  function — exactly the round-2 `NameError` that broke HEAD), and
-- **unused imports** (the most common dead-code drift).
-
-Scope approximation: names defined *anywhere* in a module (any scope) count
-as defined everywhere in it. That misses scope-escape bugs but has no false
-positives on idiomatic code, which is the right trade for a `-D warnings`
-style gate. Lines containing ``# noqa`` are exempt.
-
-Usage: python scripts/lint.py [paths...]   (default: kaboodle_tpu tests
-bench.py __graft_entry__.py scripts)
+Kept so old invocations (`python scripts/lint.py [paths...]`) still work;
+the two original checks live on as rules KB101/KB102 there.
 """
-
-from __future__ import annotations
-
-import ast
-import builtins
-import pathlib
+import os
 import sys
 
-IMPLICIT = {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__class__", "__annotations__",
-}
-
-
-def _collect_defined(tree: ast.AST) -> tuple[set, dict]:
-    """All names bound anywhere (any scope), plus import bindings -> lineno."""
-    defined = set(dir(builtins)) | IMPLICIT
-    imports: dict[str, tuple[int, bool]] = {}  # name -> (lineno, is_future)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                name = (a.asname or a.name).split(".")[0]
-                defined.add(name)
-                imports.setdefault(name, (node.lineno, False))
-        elif isinstance(node, ast.ImportFrom):
-            future = node.module == "__future__"
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                name = a.asname or a.name
-                defined.add(name)
-                imports.setdefault(name, (node.lineno, future))
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            defined.add(node.name)
-        elif isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
-            defined.add(node.id)
-        elif isinstance(node, ast.arg):
-            defined.add(node.arg)
-        elif isinstance(node, ast.ExceptHandler) and node.name:
-            defined.add(node.name)
-        elif isinstance(node, (ast.Global, ast.Nonlocal)):
-            defined.update(node.names)
-        elif isinstance(node, (ast.MatchAs, ast.MatchStar)) and node.name:
-            defined.add(node.name)
-        elif isinstance(node, ast.MatchMapping) and node.rest:
-            defined.add(node.rest)
-    return defined, imports
-
-
-def _collect_used(tree: ast.AST) -> tuple[set, list]:
-    """Names loaded anywhere + every (lineno, name) load for the checker."""
-    used = set()
-    loads = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            used.add(node.id)
-            loads.append((node.lineno, node.id))
-    # __all__ re-export strings count as uses (package __init__ pattern).
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
-            )
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                    used.add(elt.value)
-    return used, loads
-
-
-def check_file(path: pathlib.Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    src_lines = src.splitlines()
-
-    def noqa(lineno: int) -> bool:
-        return 0 < lineno <= len(src_lines) and "noqa" in src_lines[lineno - 1]
-
-    defined, imports = _collect_defined(tree)
-    used, loads = _collect_used(tree)
-
-    errors = []
-    for lineno, name in loads:
-        if name not in defined and not noqa(lineno):
-            errors.append(f"{path}:{lineno}: undefined name '{name}'")
-    for name, (lineno, future) in imports.items():
-        if future or name == "_" or noqa(lineno):
-            continue
-        if name not in used:
-            errors.append(f"{path}:{lineno}: unused import '{name}'")
-    return errors
-
-
-def main(argv: list[str]) -> int:
-    targets = argv or [
-        "kaboodle_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py"
-    ]
-    files: list[pathlib.Path] = []
-    for t in targets:
-        p = pathlib.Path(t)
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    errors = []
-    for f in files:
-        errors.extend(check_file(f))
-    for e in errors:
-        print(e)
-    print(f"lint: {len(files)} files, {len(errors)} errors", file=sys.stderr)
-    return 1 if errors else 0
-
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from kaboodle_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))

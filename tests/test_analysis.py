@@ -1,0 +1,437 @@
+"""graftlint (kaboodle_tpu.analysis) — rule fixtures, suppression, CLI.
+
+Pure AST: nothing here traces or imports a backend (the analyzer itself
+never imports jax), so the whole module runs in the fast lane. Each rule
+gets a positive and a negative fixture; noqa and baseline suppression are
+exercised through the same public entry points CI uses.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+from kaboodle_tpu.analysis import analyze_source
+from kaboodle_tpu.analysis.cli import main
+from kaboodle_tpu.analysis.core import REGISTRY, _load_rules, noqa_codes
+
+
+def rules_of(src: str, path: str = "module.py") -> list[str]:
+    return [f.rule for f in analyze_source(textwrap.dedent(src), path)]
+
+
+# ---------------------------------------------------------------------------
+# KB1xx generic
+
+
+def test_kb101_undefined_name():
+    assert "KB101" in rules_of("x = deleted_function()\n")
+    assert "KB101" not in rules_of("def f():\n    return 1\nx = f()\n")
+
+
+def test_kb102_unused_import():
+    assert "KB102" in rules_of("import os\n")
+    assert "KB102" not in rules_of("import os\np = os.getcwd()\n")
+    # __all__ strings count as uses; __future__ is exempt
+    assert "KB102" not in rules_of(
+        "from __future__ import annotations\nfrom x import y\n__all__ = ['y']\n"
+    )
+
+
+def test_kb103_mutable_default():
+    assert "KB103" in rules_of("def f(a, b=[]):\n    return b\n")
+    assert "KB103" in rules_of("def f(a, b=dict()):\n    return b\n")
+    assert "KB103" not in rules_of("def f(a, b=None, c=()):\n    return b\n")
+
+
+def test_kb104_shadowed_builtin():
+    assert "KB104" in rules_of("id = 3\n")
+    assert "KB104" in rules_of("def f(type):\n    return type\n")
+    # annotations are loads, not bindings; benign names don't fire
+    assert "KB104" not in rules_of("def f(x: object) -> bytes:\n    return x\n")
+
+
+# ---------------------------------------------------------------------------
+# KB201 — traced branches
+
+
+def test_kb201_jit_decorated_branch():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert rules_of(src).count("KB201") == 1
+
+
+def test_kb201_static_argnames_exempt():
+    src = """
+    import functools, jax
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def f(x, cfg):
+        if cfg:
+            return x
+        if x > 0:
+            return x
+        return -x
+    """
+    assert rules_of(src).count("KB201") == 1  # only the `if x > 0`
+
+
+def test_kb201_structural_tests_exempt():
+    src = """
+    import jax
+    @jax.jit
+    def f(x, mask):
+        if mask is None:
+            return x
+        if x.shape[0] > 2:
+            return x
+        return x
+    """
+    assert "KB201" not in rules_of(src)
+
+
+def test_kb201_lax_cond_callee_and_untraced_negative():
+    src = """
+    import jax
+    def branch(x):
+        if x:
+            return x
+        return x
+    def host_only(y):
+        if y:
+            return y
+        return y
+    def outer(a, b):
+        return jax.lax.cond(a, branch, branch, b)
+    """
+    found = analyze_source(textwrap.dedent(src), "m.py")
+    kb201 = [f for f in found if f.rule == "KB201"]
+    assert len(kb201) == 1 and "branch" in kb201[0].symbol
+
+
+def test_kb201_distinct_conditions_get_distinct_keys():
+    """A baselined `if deterministic:` must not mask a later tracer branch
+    added to the same function — the symbol carries the tainted names."""
+    src = """
+    import jax
+    @jax.jit
+    def f(x, deterministic):
+        if deterministic:
+            return x
+        if x > 0:
+            return x
+        return -x
+    """
+    found = [f for f in analyze_source(textwrap.dedent(src)) if f.rule == "KB201"]
+    assert len(found) == 2
+    assert len({f.key for f in found}) == 2
+    assert any("(deterministic)" in f.symbol for f in found)
+    assert any("(x)" in f.symbol for f in found)
+
+
+def test_kb201_traced_pragma_and_taint_propagation():
+    src = """
+    def tick(st, inp):  # graftlint: traced
+        t = st.tick
+        if t > 3:
+            return t
+        return st
+    """
+    assert "KB201" in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# KB202 — host coercions
+
+
+def test_kb202_coercions():
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        a = float(x)
+        b = x.item()
+        c = np.asarray(x)
+        return a, b, c
+    """
+    assert rules_of(src).count("KB202") == 3
+
+
+def test_kb202_static_reads_exempt():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        n = int(x.shape[0])
+        return x + n
+    """
+    assert "KB202" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# KB203 — print in jit
+
+
+def test_kb203_print():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        print("tracing", x)
+        jax.debug.print("x={}", x)
+        return x
+    """
+    assert rules_of(src).count("KB203") == 1
+
+
+def test_kb203_host_print_ok():
+    assert "KB203" not in rules_of("def f(x):\n    print(x)\n    return x\n")
+
+
+# ---------------------------------------------------------------------------
+# KB204 — key reuse
+
+
+def test_kb204_reuse():
+    src = """
+    import jax
+    def f():
+        k = jax.random.key(0)
+        a = jax.random.uniform(k, (3,))
+        b = jax.random.normal(k, (3,))
+        return a, b
+    """
+    assert rules_of(src).count("KB204") == 1
+
+
+def test_kb204_split_and_branches_ok():
+    src = """
+    import jax
+    def g():
+        k = jax.random.key(0)
+        k1, k2 = jax.random.split(k)
+        a = jax.random.uniform(k1, (3,))
+        b = jax.random.normal(k2, (3,))
+        return a, b
+    def branches(det):
+        k = jax.random.key(0)
+        if det:
+            return jax.random.uniform(k, (3,))
+        else:
+            return jax.random.normal(k, (3,))
+    """
+    assert "KB204" not in rules_of(src)
+
+
+def test_kb204_sibling_except_arms_ok():
+    """Mutually-exclusive except arms are separate execution paths."""
+    src = """
+    import jax
+    def f(k):
+        k = jax.random.key(0)
+        try:
+            x = 1
+        except ValueError:
+            return jax.random.uniform(k, (3,))
+        except KeyError:
+            return jax.random.normal(k, (3,))
+        return x
+    """
+    assert "KB204" not in rules_of(src)
+
+
+def test_kb204_rebind_clears():
+    src = """
+    import jax
+    def f():
+        k = jax.random.key(0)
+        a = jax.random.uniform(k, (3,))
+        k = jax.random.key(1)
+        b = jax.random.normal(k, (3,))
+        return a, b
+    """
+    assert "KB204" not in rules_of(src)
+
+
+# ---------------------------------------------------------------------------
+# KB205 — use after donation
+
+
+def test_kb205_use_after_donation():
+    src = """
+    import jax
+    tick = jax.jit(step, donate_argnums=0)
+    def bad(st, inp):
+        out = tick(st, inp)
+        return st.alive
+    def good(st, inp):
+        st, m = tick(st, inp)
+        return st.alive
+    def loop(st, inp):
+        for _ in range(4):
+            st, m = tick(st, inp)
+        return st
+    """
+    found = analyze_source(textwrap.dedent(src), "m.py")
+    kb205 = [f for f in found if f.rule == "KB205"]
+    assert len(kb205) == 1 and "bad" in kb205[0].symbol
+
+
+def test_kb205_donate_argnames_resolved_through_local_def():
+    src = """
+    import jax
+    def step(st, inp):
+        return st, inp
+    tick = jax.jit(step, donate_argnames="st")
+    def bad(st, inp):
+        out = tick(st, inp)
+        return st
+    """
+    found = analyze_source(textwrap.dedent(src), "m.py")
+    assert [f.rule for f in found].count("KB205") == 1
+
+
+# ---------------------------------------------------------------------------
+# KB3xx — hot-path scoping
+
+
+HOT_SYNC = """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    y = np.asarray(x)
+    x.block_until_ready()
+    return jax.device_get(x)
+"""
+
+
+def test_kb301_scoped_to_hot_dirs():
+    hot = rules_of(HOT_SYNC, "kaboodle_tpu/sim/foo.py")
+    assert hot.count("KB301") == 3
+    assert "KB301" not in rules_of(HOT_SYNC, "kaboodle_tpu/transport/foo.py")
+
+
+def test_kb301_module_level_numpy_ok():
+    src = """
+    import numpy as np
+    TABLE = np.zeros(256, dtype=np.uint32)
+    """
+    assert "KB301" not in rules_of(src, "kaboodle_tpu/ops/tables.py")
+
+
+def test_kb302_dtype_discipline():
+    src = """
+    import jax.numpy as jnp
+    def f(n):
+        return jnp.arange(n)
+    """
+    ok = """
+    import jax.numpy as jnp
+    def f(n):
+        return jnp.arange(n, dtype=jnp.int32), jnp.zeros((n,), jnp.uint32)
+    """
+    assert "KB302" in rules_of(src, "kaboodle_tpu/ops/crc32.py")
+    assert "KB302" not in rules_of(ok, "kaboodle_tpu/ops/crc32.py")
+    # discipline files only — elsewhere the default dtype is fine
+    assert "KB302" not in rules_of(src, "kaboodle_tpu/ops/pallas_util.py")
+    assert "KB302" not in rules_of(src, "kaboodle_tpu/transport/codec.py")
+
+
+# ---------------------------------------------------------------------------
+# suppression: noqa + baseline
+
+
+def test_noqa_codes_parsing():
+    assert noqa_codes("x = 1  # noqa") == frozenset({"*"})
+    assert noqa_codes("x = 1  # noqa: KB104") == frozenset({"KB104"})
+    assert noqa_codes("x = 1  # noqa: KB104, KB201") == frozenset({"KB104", "KB201"})
+    # foreign linter codes keep the historical blanket-waiver semantics
+    assert noqa_codes("import jax  # noqa: E402") == frozenset({"*"})
+    assert noqa_codes("x = 1") == frozenset()
+
+
+def test_noqa_suppresses_specific_rule():
+    assert "KB104" not in rules_of("id = 3  # noqa: KB104\n")
+    assert "KB104" in rules_of("id = 3  # noqa: KB101\n")
+    assert "KB104" not in rules_of("id = 3  # noqa\n")
+
+
+def test_baseline_cli_roundtrip(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "a.py").write_text("import os\n")  # KB102
+
+    assert main(["a.py"]) == 1
+    assert "KB102" in capsys.readouterr().out
+
+    assert main(["--write-baseline", "a.py"]) == 0
+    assert main(["a.py"]) == 0  # baselined now
+    assert main(["--no-baseline", "a.py"]) == 1  # ignoring it fires again
+
+    # --no-baseline-growth fails on stale entries so debt can only shrink
+    data = json.loads((tmp_path / ".graftlint_baseline.json").read_text())
+    data["entries"].append({"key": "gone.py::KB102::os", "reason": "stale"})
+    (tmp_path / ".graftlint_baseline.json").write_text(json.dumps(data))
+    assert main(["a.py"]) == 0  # plain run tolerates the stale entry
+    assert main(["--no-baseline-growth", "a.py"]) == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+
+
+def test_baseline_requires_justification(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "a.py").write_text("x = 1\n")
+    (tmp_path / ".graftlint_baseline.json").write_text(
+        json.dumps({"entries": [{"key": "a.py::KB102::os"}]})
+    )
+    assert main(["a.py"]) == 2
+
+
+def test_syntax_error_is_a_finding(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "a.py").write_text("def broken(:\n")
+    assert main(["--no-baseline", "a.py"]) == 1
+    assert "KB100" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# CLI odds and ends + registry hygiene
+
+
+def test_cli_explain_and_list(capsys):
+    assert main(["--explain", "KB201"]) == 0
+    assert "lax.cond" in capsys.readouterr().out
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("KB101", "KB204", "KB302"):
+        assert rid in out
+    assert main(["--explain", "KB999"]) == 2
+    assert main(["--bogus-flag"]) == 2
+
+
+def test_registry_docs_complete():
+    _load_rules()
+    expected = {
+        "KB101", "KB102", "KB103", "KB104",
+        "KB201", "KB202", "KB203", "KB204", "KB205",
+        "KB301", "KB302",
+    }
+    assert expected <= set(REGISTRY)
+    for r in REGISTRY.values():
+        assert r.title and len(r.explain) > 40
+
+
+def test_repo_is_clean_under_baseline(monkeypatch):
+    """The acceptance gate: HEAD lints clean over the full default target
+    set (baselined findings allowed, baseline not stale). Catches
+    regressions the moment a PR adds a finding without justifying it."""
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    monkeypatch.chdir(repo)
+    assert main(["--no-baseline-growth"]) == 0

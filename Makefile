@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -44,10 +44,19 @@ sim:
 # Each driver step runs in its own process under `timeout` so a wedged
 # accelerator backend fails fast instead of eating the whole CI job; the
 # entry compile-check is pinned to CPU for the same reason (the driver runs
-# it on real hardware separately).
+# it on real hardware separately). The fleet sweep dryrun exercises the
+# whole ensemble stack (vmapped kernel -> masked converge loop -> on-device
+# stats -> table/JSON output) end-to-end at toy scale.
 ci: lint native test
 	timeout 420 $(PYTHON) __graft_entry__.py
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
+	$(MAKE) fleet-dryrun
+
+# The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
+# same target — ONE copy of the invocation).
+fleet-dryrun:
+	timeout 300 $(PYTHON) -m kaboodle_tpu fleet --platform cpu \
+	  --sweep drop_rate=0:0.2:4 --ensemble 16 --n 32 --max-ticks 32
 
 # Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
 # then the every-fault-path scan, N=8192 over 8 virtual CPU devices,

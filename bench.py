@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import resource
 import sys
 import time
@@ -66,6 +67,16 @@ def _is_oom(e: Exception) -> bool:
             or "failed to allocate" in msg.lower())
 
 
+# The tunnel's remote-compile helper surfaces its failure as an HTTP 500:
+# match only status-shaped 500s ("HTTP 500", "status: 500", "500 Internal
+# Server Error"), never a bare digit-run — a real compile bug whose message
+# happens to contain 500 (a shape dim, a line number) must surface as a
+# traceback, not be swallowed by the step-down loop (ADVICE r5).
+_REMOTE_COMPILE_500 = re.compile(
+    r"(?i)(?:http[ /]?|status(?:\s+code)?\s*[:=]?\s*|error\s+)500\b"
+    r"|\b500\s+internal\s+server\s+error")
+
+
 def _is_size_ceiling(e: Exception) -> bool:
     """Size-induced failures that warrant stepping down to a smaller N:
     memory exhaustion, or the tunnel's remote-compile-helper failure — every
@@ -75,7 +86,8 @@ def _is_size_ceiling(e: Exception) -> bool:
     msg = str(e)
     return (_is_oom(e)
             or "tpu_compile_helper" in msg
-            or ("compile" in msg.lower() and "500" in msg))
+            or ("compile" in msg.lower()
+                and _REMOTE_COMPILE_500.search(msg) is not None))
 
 
 def _newest_watch_entry(kind: str, valid=None):

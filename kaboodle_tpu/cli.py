@@ -7,12 +7,16 @@ title, and support probe mode (discover one member and exit, main.rs:70-84)
 and manual ping bootstrap (--ping addr, lib.rs:268-297).
 
 Sim mode is the TPU-native addition: run one of the benchmark scenarios (or a
-custom size) on the accelerator and stream per-tick convergence metrics.
+custom size) on the accelerator and stream per-tick convergence metrics. The
+``fleet`` subcommand is the ensemble front-end (kaboodle_tpu/fleet/bench.py):
+sweep a per-member knob grid over E batched meshes in one dispatch and print
+on-device convergence-quantile statistics.
 
     python -m kaboodle_tpu --identity my-node            # join the LAN mesh
     python -m kaboodle_tpu --probe                       # find a member, exit
     python -m kaboodle_tpu --sim 4096 --ticks 32         # simulate on TPU
     python -m kaboodle_tpu --sim-scenario 3              # BASELINE config 3
+    python -m kaboodle_tpu fleet --sweep drop_rate=0:0.3:16 --ensemble 1024
 """
 
 from __future__ import annotations
@@ -291,6 +295,14 @@ def run_sim(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "fleet":
+        # Ensemble sweep subcommand — its own parser (fleet/bench.py), so
+        # the flag surfaces of the demo app and the sweep tool stay
+        # independent.
+        from kaboodle_tpu.fleet.bench import main as fleet_main
+
+        return fleet_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         if args.sim or args.sim_scenario:

@@ -9,6 +9,12 @@ duration is re-derived in *ticks* with ``PROTOCOL_PERIOD == 1 tick``
 argument to ``jax.jit`` — changing a protocol constant recompiles the kernel,
 which is exactly the XLA-friendly behavior we want (constants fold into the
 compiled program).
+
+Static-vs-traced is also the fleet contract (kaboodle_tpu/fleet): every field
+here selects the ONE compiled program an ensemble shares, so SwimConfig values
+cannot vary across fleet members — only traced per-member inputs (the
+``TickInputs.drop_rate`` knob, the PRNG seed axis) can. A/B over a config
+field is two fleet dispatches.
 """
 
 from __future__ import annotations

@@ -1,0 +1,66 @@
+"""fleet — batched ensemble simulation with on-device Monte Carlo statistics.
+
+The ensemble as a tensor axis: E independent SWIM meshes stacked along a
+leading ``[E]`` axis, advanced in lockstep by the vmapped tick kernel, with
+convergence statistics computed as device reductions. See fleet/core.py for
+the design, fleet/stats.py for the statistics layer, fleet/sharding.py for
+the GSPMD ensemble (and ``E x peers``) distribution, and fleet/bench.py for
+the sweep CLI (``python -m kaboodle_tpu fleet``).
+
+Exports resolve lazily (PEP 562): importing this package must NOT import
+jax, so the sweep CLI's ``--platform cpu`` pin (fleet/bench.py, which strips
+the axon tunnel plugin before jax loads — a wedged tunnel hangs ``import
+jax`` itself, axon_guard.py) can run before any backend-touching module
+executes. ``from kaboodle_tpu.fleet import X`` works as usual; the owning
+submodule loads on first access.
+"""
+
+import importlib
+
+_EXPORTS = {
+    # core
+    "FleetState": "core",
+    "fleet_converge_loop": "core",
+    "fleet_idle_inputs": "core",
+    "init_fleet": "core",
+    "make_fleet_tick_fn": "core",
+    "member_state": "core",
+    "run_fleet_until_converged": "core",
+    "scan_axis_first": "core",
+    "simulate_fleet": "core",
+    "stack_member_inputs": "core",
+    # sharding
+    "ENSEMBLE_AXIS": "sharding",
+    "fleet_inputs_specs": "sharding",
+    "fleet_state_specs": "sharding",
+    "make_fleet_mesh": "sharding",
+    "make_sharded_fleet_tick": "sharding",
+    "run_fleet_until_converged_sharded": "sharding",
+    "shard_fleet": "sharding",
+    "shard_fleet_inputs": "sharding",
+    "simulate_fleet_sharded": "sharding",
+    # stats
+    "agree_fraction_trajectory": "stats",
+    "convergence_quantiles": "stats",
+    "knob_marginals": "stats",
+    "knob_quantiles": "stats",
+    "survival_curve": "stats",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        submodule = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'kaboodle_tpu.fleet' has no attribute {name!r}"
+        ) from None
+    return getattr(
+        importlib.import_module(f"kaboodle_tpu.fleet.{submodule}"), name
+    )
+
+
+def __dir__():
+    return __all__

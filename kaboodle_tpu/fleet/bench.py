@@ -1,0 +1,266 @@
+"""Sweep front-end: knob grids over the ensemble, one dispatch, one table.
+
+    python -m kaboodle_tpu fleet --sweep drop_rate=0:0.3:16 --ensemble 1024
+    python -m kaboodle_tpu fleet --ensemble 256 --n 256 --seeds-only
+
+A sweep spec ``knob=start:stop:steps`` lays a linspace grid over the
+ensemble: ``E // steps`` members per grid point (E is trimmed to a multiple
+of ``steps`` with a note), each member seeded independently, all advanced by
+ONE :func:`kaboodle_tpu.fleet.run_fleet_until_converged` dispatch. The
+statistics — overall + per-knob convergence-tick quantiles, converged
+fractions, survival curve — come out of fleet/stats.py as device reductions;
+the host sees only the table. ``--seeds-only`` (no knob grid) measures pure
+seed sensitivity at a fixed configuration.
+
+Sweepable knobs are the *traced* per-member scalars (today: ``drop_rate``).
+Static protocol flags (SwimConfig fields) select the compiled program and
+cannot vary within a fleet — A/B a static flag by invoking the sweep once
+per arm (``--flag deterministic`` etc. pins the arm for this invocation).
+
+Output contract mirrors bench.py: a human table on stdout, then one compact
+single-line JSON summary as the LAST line (machine consumers take the tail).
+Exit code 0 for any completed measurement — a zero converged fraction is a
+valid result for a harsh regime (read ``converged_fraction`` from the tail
+line), not a failure; nonzero exits are reserved for real errors (bad flags,
+backend failures).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _pin_cpu() -> None:
+    """Pin JAX to the CPU backend before any backend initializes (same
+    pattern as bench.py: strip the tunnel plugin first — a wedged tunnel
+    can hang `import jax` itself)."""
+    import os
+
+    try:
+        from axon_guard import strip_axon_plugin
+
+        strip_axon_plugin()
+    except ImportError:  # installed-package runs without the repo root
+        pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def parse_sweep(spec: str):
+    """``knob=start:stop:steps`` -> (knob, float32 grid). Only per-member
+    traced knobs are sweepable; see module docstring."""
+    import numpy as np
+
+    try:
+        knob, rng = spec.split("=", 1)
+        start_s, stop_s, steps_s = rng.split(":")
+        start, stop, steps = float(start_s), float(stop_s), int(steps_s)
+    except ValueError:
+        raise SystemExit(
+            f"bad --sweep spec {spec!r} (want knob=start:stop:steps)"
+        ) from None
+    if knob != "drop_rate":
+        raise SystemExit(
+            f"unknown sweep knob {knob!r}; sweepable per-member knobs: drop_rate"
+        )
+    if steps < 1:
+        raise SystemExit("--sweep needs steps >= 1")
+    return knob, np.linspace(start, stop, steps, dtype=np.float32)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kaboodle_tpu fleet",
+        description="batched ensemble sweep: E meshes, one dispatch, "
+        "convergence statistics on device",
+    )
+    p.add_argument("--ensemble", type=int, default=256, metavar="E",
+                   help="ensemble members (default 256)")
+    p.add_argument("--n", type=int, default=256, help="peers per mesh")
+    p.add_argument("--sweep", default=None, metavar="KNOB=A:B:STEPS",
+                   help="per-member knob grid, e.g. drop_rate=0:0.3:16")
+    p.add_argument("--seeds-only", action="store_true",
+                   help="no knob grid: pure seed-sensitivity ensemble")
+    p.add_argument("--max-ticks", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0, help="base seed (member e "
+                   "gets seed + e)")
+    p.add_argument("--boot", choices=["broadcast", "gossip", "epidemic"],
+                   default="broadcast",
+                   help="broadcast (1-tick join avalanche), gossip "
+                   "(broadcast-free, Q6 back-dating), epidemic (broadcast-"
+                   "free, fresh gossip stamps)")
+    p.add_argument("--quantiles", type=float, nargs="*",
+                   default=[0.5, 0.9, 0.99])
+    p.add_argument("--deterministic", action="store_true",
+                   help="deterministic protocol draws (A/B arm pin)")
+    p.add_argument("--shard", choices=["auto", "none", "ensemble"],
+                   default="auto",
+                   help="shard the ensemble axis over the local devices "
+                   "(auto: when >1 device and E divides)")
+    p.add_argument("--platform", choices=["cpu"], default=None,
+                   help="pin the JAX platform (avoids touching a possibly-"
+                   "wedged accelerator plugin)")
+    return p
+
+
+def run_sweep(args) -> dict:
+    import jax
+    import numpy as np
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.fleet import (
+        convergence_quantiles,
+        init_fleet,
+        knob_marginals,
+        knob_quantiles,
+        run_fleet_until_converged,
+        survival_curve,
+    )
+
+    ensemble = args.ensemble
+    knob_name, grid = (None, None)
+    if args.sweep and args.seeds_only:
+        # Silently dropping either flag would hand back the wrong
+        # measurement with exit code 0 — refuse the contradiction.
+        raise SystemExit("--sweep and --seeds-only are mutually exclusive")
+    if args.sweep:
+        knob_name, grid = parse_sweep(args.sweep)
+        steps = grid.shape[0]
+        if ensemble < steps:
+            raise SystemExit(
+                f"--ensemble {ensemble} < sweep steps {steps}: need at "
+                "least one member per grid point (shrink the grid or grow "
+                "the ensemble)")
+        if ensemble % steps:
+            trimmed = ensemble - ensemble % steps
+            print(f"fleet: --ensemble {ensemble} trimmed to {trimmed} "
+                  f"(multiple of {steps} grid points)", file=sys.stderr)
+            ensemble = trimmed
+        knob = np.repeat(grid, ensemble // steps)
+    else:
+        knob = np.zeros((ensemble,), dtype=np.float32)
+
+    cfg = SwimConfig(
+        deterministic=args.deterministic,
+        join_broadcast_enabled=args.boot == "broadcast",
+        backdate_gossip_inserts=args.boot != "epidemic",
+    )
+    # Lean state: the fleet resident is E x one mesh; latency EWMA and
+    # per-row identity views are statistics-irrelevant here.
+    fleet = init_fleet(
+        args.n, ensemble,
+        seeds=args.seed + np.arange(ensemble, dtype=np.int64),
+        drop_rates=knob,
+        track_latency=False, instant_identity=True,
+        ring_contacts=0 if args.boot == "broadcast" else 2,
+    )
+    faulty = bool(np.any(knob > 0))
+
+    n_dev = jax.local_device_count()
+    sharded = (args.shard == "ensemble"
+               or (args.shard == "auto" and n_dev > 1 and ensemble % n_dev == 0))
+    if sharded:
+        from kaboodle_tpu.fleet import (
+            make_fleet_mesh,
+            run_fleet_until_converged_sharded,
+            shard_fleet,
+        )
+
+        mesh = make_fleet_mesh()
+        fleet = shard_fleet(fleet, mesh)
+        t0 = time.perf_counter()
+        fleet, conv_tick, done = run_fleet_until_converged_sharded(
+            fleet, cfg, mesh, max_ticks=args.max_ticks, faulty=faulty)
+    else:
+        t0 = time.perf_counter()
+        fleet, conv_tick, done = run_fleet_until_converged(
+            fleet, cfg, max_ticks=args.max_ticks, faulty=faulty)
+
+    qs = tuple(args.quantiles)
+    overall_q = convergence_quantiles(conv_tick, done, qs=qs)
+    surv = survival_curve(conv_tick, done, max_ticks=args.max_ticks)
+    per_knob = None
+    if grid is not None:
+        values = np.asarray(grid, dtype=np.float32)
+        marg = knob_marginals(knob, values, conv_tick, done)
+        kq = knob_quantiles(knob, values, conv_tick, done, qs=qs)
+        per_knob = (values, marg, kq)
+    # ONE host fetch at the end: everything above is device-side.
+    conv_frac = float(np.mean(np.asarray(done)))
+    wall = time.perf_counter() - t0
+
+    qcols = "  ".join(f"p{int(q * 100):<4}" for q in qs)
+    print(f"fleet: E={ensemble} N={args.n} boot={args.boot} "
+          f"max_ticks={args.max_ticks} backend={jax.default_backend()}"
+          f"{' sharded' if sharded else ''}")
+    print(f"{'knob':>10}  {'members':>7}  {'conv%':>6}  {'mean':>6}  {qcols}")
+    overall_qv = np.asarray(overall_q)
+
+    def qfmt(row):
+        return "  ".join(f"{v:5.1f}" for v in row)
+
+    if per_knob is not None:
+        values, marg, kq = per_knob
+        members = np.asarray(marg["members"])
+        fracs = np.asarray(marg["converged_fraction"])
+        means = np.asarray(marg["mean_conv_tick"])
+        kqv = np.asarray(kq)
+        for b, v in enumerate(values):
+            print(f"{knob_name}={v:<6.3f}  {members[b]:>7}  "
+                  f"{100 * fracs[b]:>5.1f}%  {means[b]:>6.1f}  {qfmt(kqv[b])}")
+    print(f"{'ALL':>10}  {ensemble:>7}  {100 * conv_frac:>5.1f}%  "
+          f"{'':>6}  {qfmt(overall_qv)}")
+
+    line = {
+        "metric": "fleet_convergence_quantiles",
+        "ensemble": ensemble,
+        "n_peers": args.n,
+        "boot": args.boot,
+        "sweep": args.sweep if grid is not None else None,
+        "faulty": faulty,
+        "sharded": sharded,
+        "backend": jax.default_backend(),
+        "converged_fraction": round(conv_frac, 4),
+        "quantiles": {f"p{int(q * 100)}": round(float(v), 2)
+                      for q, v in zip(qs, overall_qv)},
+        "survival_tail": round(float(np.asarray(surv)[-1]), 4),
+        "max_ticks": args.max_ticks,
+        "wall_s": round(wall, 3),
+    }
+    if per_knob is not None:
+        values, marg, kq = per_knob
+        line["per_knob"] = [
+            {
+                "knob": knob_name,
+                "value": round(float(v), 4),
+                "members": int(np.asarray(marg["members"])[b]),
+                "converged_fraction": round(
+                    float(np.asarray(marg["converged_fraction"])[b]), 4),
+                "mean_conv_tick": round(
+                    float(np.asarray(marg["mean_conv_tick"])[b]), 2),
+                "quantiles": {f"p{int(q * 100)}": round(float(x), 2)
+                              for q, x in zip(qs, np.asarray(kq)[b])},
+            }
+            for b, v in enumerate(values)
+        ]
+    return line
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform == "cpu":
+        _pin_cpu()
+    line = run_sweep(args)
+    print(json.dumps(line))
+    # A completed measurement is success even when nothing converged (the
+    # non-convergent region of a sweep is a designed outcome, not an error).
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""Batched ensemble simulation: E independent SWIM meshes as one tensor axis.
+
+Every engine in the repo — the dense kernel (sim/kernel.py), the chunked
+twin (sim/chunked.py), the sharded twin (parallel/mesh.py) — advances
+exactly ONE mesh per dispatch, so any statistical question (convergence-time
+distribution vs drop rate, recovery curves under churn, seed sensitivity)
+costs one full dispatch per sample and leaves the batch dimension idle.
+This module makes the *ensemble* a tensor axis: a :class:`FleetState` stacks
+E complete ``MeshState`` pytrees along a leading ``[E]`` axis and the tick
+kernel is ``jax.vmap``-ped over it, so thousands of independent meshes
+advance in lockstep inside one XLA program (the data-oriented batched-
+simulator design of Potato, arXiv:2308.12698 — the ensemble is data, not a
+host loop).
+
+Members share every *static* property (N, protocol config, state variant —
+one compiled program) and vary in everything *traced*: the per-member PRNG
+key (seed axis) and per-member scalar knobs such as ``drop_rate`` (one
+float per member, broadcast by the vmapped kernel into that member's
+delivery gate). Static protocol flags (``SwimConfig``) cannot vary within a
+fleet — they select the compiled program; an A/B over a static flag is two
+fleet dispatches (see fleet/bench.py).
+
+Parity contract (tests/test_fleet.py): member ``k`` of a fleet is BIT-EXACT
+with a standalone single-mesh run from ``init_state(n, seed=seeds[k])`` —
+``vmap`` only batches the same per-row ops the single-mesh kernel runs, all
+integer state is exact, and the per-member PRNG streams are the standalone
+streams. The oracle cross-checks therefore extend to every fleet member by
+sampling: whatever parity the single-mesh kernel has (PARITY.md), the fleet
+inherits.
+
+Convergence-bounded runs use a MASKED while_loop
+(:func:`run_fleet_until_converged`): the fleet keeps dispatching ticks while
+any member is unconverged, but a member that has already hit fingerprint
+agreement is frozen — its carried state stops updating at exactly the tick
+it converged (matching what a standalone ``run_until_converged`` would have
+returned), and its convergence tick is recorded on-device in an ``[E]``
+vector. The loop cost is max(member ticks), not sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import (
+    MeshState,
+    TickInputs,
+    TickMetrics,
+    idle_inputs,
+    init_state,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FleetState:
+    """E stacked meshes + the per-member knob vector.
+
+    ``mesh`` is a ``MeshState`` whose every leaf carries a leading ``[E]``
+    axis (``state``: int8 ``[E, N, N]``, ``tick``: int32 ``[E]``, ``key``:
+    ``[E, 2]``, ...). ``drop_rate`` is the per-member uniform message-drop
+    knob consumed by faulty-mode runs (float32 ``[E]``; inert under
+    ``faulty=False``, like the single-mesh kernel).
+    """
+
+    mesh: MeshState
+    drop_rate: jax.Array  # float32 [E]
+
+    @property
+    def ensemble(self) -> int:
+        return self.mesh.alive.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.mesh.state.shape[-1]
+
+
+def init_fleet(
+    n: int,
+    ensemble: int,
+    seeds: jax.Array | None = None,
+    drop_rates: jax.Array | None = None,
+    **init_state_kwargs,
+) -> FleetState:
+    """Fresh E-member fleet: every member is ``init_state(n, seed=seeds[e])``.
+
+    All non-key state is identical across members at init (one broadcast, no
+    E-fold host loop); the per-member keys are ``vmap(PRNGKey)(seeds)``,
+    bit-identical to the keys the standalone inits would hold. ``seeds``
+    defaults to ``0..E-1``; ``drop_rates`` defaults to all-zero.
+    ``init_state_kwargs`` pass through (``ring_contacts``, ``track_latency``,
+    ``instant_identity``, ``timer_dtype``, ``announced`` — the lean-state
+    knobs matter at fleet scale: the resident is E times one mesh).
+    """
+    if ensemble < 1:
+        raise ValueError("need ensemble >= 1")
+    if seeds is None:
+        seeds = jnp.arange(ensemble, dtype=jnp.int32)
+    seeds = jnp.asarray(seeds)
+    if seeds.shape != (ensemble,):
+        raise ValueError(f"seeds must be [{ensemble}], got {seeds.shape}")
+    if drop_rates is None:
+        drop_rates = jnp.zeros((ensemble,), dtype=jnp.float32)
+    drop_rates = jnp.asarray(drop_rates, dtype=jnp.float32)
+    if drop_rates.shape != (ensemble,):
+        raise ValueError(f"drop_rates must be [{ensemble}], got {drop_rates.shape}")
+    base = init_state(n, seed=0, **init_state_kwargs)
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (ensemble,) + x.shape), base
+    )
+    keys = jax.vmap(jax.random.PRNGKey)(seeds)
+    return FleetState(
+        mesh=dataclasses.replace(stacked, key=keys), drop_rate=drop_rates
+    )
+
+
+def member_state(fleet: FleetState, e: int) -> MeshState:
+    """Member ``e``'s mesh as a standalone (unstacked) ``MeshState``."""
+    return jax.tree.map(lambda x: x[e], fleet.mesh)
+
+
+def fleet_idle_inputs(
+    n: int,
+    ensemble: int,
+    ticks: int | None = None,
+    drop_rate: jax.Array | None = None,
+) -> TickInputs:
+    """No-fault per-member inputs, ``[E, ...]`` (``[T, E, ...]`` with ticks).
+
+    ``drop_rate`` is the per-member knob vector (float32 ``[E]``, default
+    zero) — the one scalar input that varies across the ensemble; it is
+    broadcast along the scan axis when ``ticks`` is given.
+
+    Derived from the single-mesh :func:`~kaboodle_tpu.sim.state.idle_inputs`
+    by broadcasting, so a new ``TickInputs`` field keeps ONE idle
+    definition.
+    """
+    if drop_rate is None:
+        drop_rate = jnp.zeros((ensemble,), dtype=jnp.float32)
+    drop_rate = jnp.asarray(drop_rate, dtype=jnp.float32)
+
+    def stack(x):
+        return jnp.broadcast_to(x[None], (ensemble,) + x.shape)
+
+    inputs = dataclasses.replace(
+        jax.tree.map(stack, idle_inputs(n)), drop_rate=drop_rate
+    )
+    if ticks is not None:
+        inputs = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (ticks,) + x.shape), inputs
+        )
+    return inputs
+
+
+def stack_member_inputs(inputs: list[TickInputs]) -> TickInputs:
+    """Stack per-member ``TickInputs`` (e.g. from the Scenario DSL) along a
+    new ``[E]`` axis. Stacked-[T] schedules stack to ``[E, T, ...]`` — pass
+    through :func:`scan_axis_first` before scanning."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *inputs)
+
+
+def scan_axis_first(inputs: TickInputs) -> TickInputs:
+    """``[E, T, ...]`` member-major inputs -> the ``[T, E, ...]`` scan layout."""
+    return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), inputs)
+
+
+def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True):
+    """The single-mesh tick kernel vmapped over the leading ensemble axis.
+
+    One compiled program advances all E members a tick; every ``lax.cond``
+    the kernel gates rare phases with batches to a select under ``vmap``
+    (both branches execute for the whole fleet whenever any member needs
+    one — the lockstep price of batching; the [E]-wide masks keep the
+    results exact). The fused Pallas stage kernels do not batch — they are
+    demoted-off by default (PERF.md "Pallas policy") and rejected here so a
+    config that re-enables them fails loudly instead of miscompiling under
+    vmap.
+    """
+    if cfg.use_pallas_fp or cfg.use_pallas_oldest_k or cfg.use_pallas_suspicion:
+        raise ValueError(
+            "fleet: the fused Pallas stage kernels do not support vmap; "
+            "use the default jnp formulations (use_pallas_*=False)"
+        )
+    return jax.vmap(make_tick_fn(cfg, faulty=faulty))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "faulty"))
+def simulate_fleet(
+    fleet: FleetState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    faulty: bool = True,
+) -> tuple[FleetState, TickMetrics]:
+    """Scan the vmapped tick over ``[T, E, ...]`` stacked inputs.
+
+    The ensemble twin of :func:`kaboodle_tpu.sim.runner.simulate`: one
+    ``lax.scan`` dispatch advances all members T ticks and returns per-tick
+    per-member metrics (``TickMetrics`` leaves shaped ``[T, E]`` — the raw
+    material of fleet/stats.py's trajectory reductions).
+    """
+    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+    mesh, metrics = jax.lax.scan(vtick, fleet.mesh, inputs)
+    return dataclasses.replace(fleet, mesh=mesh), metrics
+
+
+def fleet_converge_loop(
+    mesh: MeshState,
+    vtick,
+    idle: TickInputs,
+    max_ticks: int,
+) -> tuple[MeshState, jax.Array, jax.Array]:
+    """Masked ``lax.while_loop`` of a vmapped tick until every member agrees.
+
+    The ensemble generalization of :func:`kaboodle_tpu.sim.runner.
+    converge_loop`: the loop runs while any member is unconverged (and
+    ``i < max_ticks``), and a converged member's carry is frozen by an
+    ``[E]``-mask select — its state stops at exactly the end-of-tick state
+    where its fingerprints first agreed, so member trajectories match what
+    standalone convergence runs would return (tests/test_fleet.py pins it).
+    Shared by the single-device and sharded entry points (fleet/sharding.py
+    wraps its constrained tick around this).
+
+    Returns ``(final_mesh, conv_tick, converged)``: ``conv_tick[e]`` is the
+    tick count at which member e converged (== the standalone run's
+    ``ticks_run``), ``max_ticks`` where it never did.
+    """
+    ensemble = mesh.alive.shape[0]
+
+    def cond(carry):
+        _, _, done, i = carry
+        return jnp.any(~done) & (i < max_ticks)
+
+    def body(carry):
+        st, conv_tick, done, i = carry
+        new_st, m = vtick(st, idle)
+        # Freeze finished members: their carry (state, timer, tick counter,
+        # PRNG key — every leaf) must stop at the convergence tick.
+        st = jax.tree.map(
+            lambda old, new: jnp.where(
+                done.reshape((ensemble,) + (1,) * (new.ndim - 1)), old, new
+            ),
+            st,
+            new_st,
+        )
+        conv_tick = jnp.where(~done & m.converged, i + 1, conv_tick)
+        return st, conv_tick, done | m.converged, i + 1
+
+    mesh, conv_tick, done, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            mesh,
+            jnp.full((ensemble,), max_ticks, dtype=jnp.int32),
+            jnp.zeros((ensemble,), dtype=bool),
+            jnp.int32(0),
+        ),
+    )
+    return mesh, conv_tick, done
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_ticks", "faulty"))
+def run_fleet_until_converged(
+    fleet: FleetState,
+    cfg: SwimConfig,
+    max_ticks: int = 64,
+    faulty: bool = False,
+) -> tuple[FleetState, jax.Array, jax.Array]:
+    """Tick the whole fleet until every member converges (or ``max_ticks``).
+
+    ONE dispatch for the whole ensemble; each member's convergence tick is
+    recorded on-device (``[E]`` int32 — feed fleet/stats.py, never a
+    per-member host round-trip). ``faulty=False`` compiles the fault-free
+    kernel (``fleet.drop_rate`` inert, the ``run_until_converged`` twin);
+    ``faulty=True`` compiles the fault path so the per-member ``drop_rate``
+    knob gates delivery — the drop-rate-sweep mode of fleet/bench.py.
+    """
+    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+    idle = fleet_idle_inputs(fleet.n, fleet.ensemble, drop_rate=fleet.drop_rate)
+    mesh, conv_tick, done = fleet_converge_loop(fleet.mesh, vtick, idle, max_ticks)
+    return dataclasses.replace(fleet, mesh=mesh), conv_tick, done

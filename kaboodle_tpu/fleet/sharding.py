@@ -1,0 +1,211 @@
+"""Ensemble sharding: the ``[E]`` axis over the device mesh, via GSPMD.
+
+Same design as the peer-sharding layer this rides on (parallel/mesh.py):
+write global-view array programs, pin the layout with ``NamedSharding`` /
+``with_sharding_constraint``, and let XLA's SPMD partitioner insert the
+collectives. Two layouts:
+
+- **1-D ensemble mesh** (:func:`make_fleet_mesh`): the ``[E]`` axis split
+  across all chips, each member entirely on one device. Members are
+  independent, so the tick partitions with ZERO cross-device traffic —
+  embarrassingly-parallel Monte Carlo; only the fleet-wide convergence
+  reductions (``any(~done)``, the stats layer's quantile sorts) cross the
+  ICI. The default for big-E sweeps.
+- **2-D ``E x peers`` mesh** (:func:`make_fleet_mesh`, ``peer_devices > 1``):
+  ensemble on one mesh axis, the peer (row) axis on the other — for big-N
+  members whose single-mesh state exceeds one chip. Each member's tick then
+  partitions exactly like the single-mesh sharded twin (row-local
+  reductions + peer-axis collectives), replicated independently along the
+  ensemble axis.
+
+The specs are the peer-layer's ``state_specs`` with the ensemble axis
+prepended (peer entries dropped on the 1-D mesh), so the two layers cannot
+drift: a new ``MeshState`` field gets its fleet placement from the same
+single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.fleet.core import (
+    FleetState,
+    fleet_converge_loop,
+    fleet_idle_inputs,
+    make_fleet_tick_fn,
+)
+from kaboodle_tpu.parallel.mesh import PEER_AXIS, inputs_specs, state_specs
+from kaboodle_tpu.sim.state import MeshState, TickInputs
+
+ENSEMBLE_AXIS = "ensemble"
+
+
+def make_fleet_mesh(
+    ensemble_devices: int | None = None,
+    peer_devices: int = 1,
+    devices=None,
+) -> Mesh:
+    """Device mesh for a fleet: 1-D over ``ensemble``, or 2-D ``E x peers``.
+
+    ``peer_devices == 1`` (default) gives the 1-D ensemble mesh over
+    ``ensemble_devices`` chips (all local devices by default). With
+    ``peer_devices > 1`` the devices reshape to
+    ``(ensemble_devices, peer_devices)`` — device order keeps each member's
+    peer group contiguous, so the heavy per-member row traffic stays on the
+    fastest links and only the tiny fleet-wide reductions span the ensemble
+    axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if ensemble_devices is None:
+        if len(devices) % peer_devices != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by peer_devices={peer_devices}"
+            )
+        ensemble_devices = len(devices) // peer_devices
+    total = ensemble_devices * peer_devices
+    if total > len(devices):
+        raise ValueError(f"asked for {total} devices, have {len(devices)}")
+    devs = np.asarray(devices[:total])
+    if peer_devices == 1:
+        return Mesh(devs, (ENSEMBLE_AXIS,))
+    return Mesh(
+        devs.reshape(ensemble_devices, peer_devices), (ENSEMBLE_AXIS, PEER_AXIS)
+    )
+
+
+def _stacked(spec: P, peers_sharded: bool) -> P:
+    """Prepend the ensemble axis to a peer-layer spec; on a 1-D ensemble
+    mesh the peer entries collapse to None (the axis does not exist)."""
+    parts = tuple(spec) if peers_sharded else tuple(None for _ in tuple(spec))
+    return P(ENSEMBLE_AXIS, *parts)
+
+
+def fleet_state_specs(fleet: FleetState | None = None, peers_sharded: bool = False):
+    """PartitionSpecs for a FleetState (see module docstring)."""
+    mesh_state = fleet.mesh if fleet is not None else None
+    base = state_specs(mesh_state)
+    mesh_specs = jax.tree.map(
+        lambda s: _stacked(s, peers_sharded), base, is_leaf=lambda x: isinstance(x, P)
+    )
+    return FleetState(mesh=mesh_specs, drop_rate=P(ENSEMBLE_AXIS))
+
+
+def fleet_inputs_specs(
+    stacked: bool = False,
+    with_drop_ok: bool = False,
+    peers_sharded: bool = False,
+) -> TickInputs:
+    """PartitionSpecs for fleet TickInputs (``[E, ...]``; ``stacked`` adds
+    the leading scan [T] axis: ``[T, E, ...]``)."""
+    lead = (None,) if stacked else ()
+    base = inputs_specs(stacked=False, with_drop_ok=with_drop_ok)
+    return jax.tree.map(
+        lambda s: P(*lead, *tuple(_stacked(s, peers_sharded))),
+        base,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _named(mesh: Mesh, specs):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def _check_fleet_divisible(ensemble: int, n: int, mesh: Mesh) -> None:
+    e_dev = mesh.shape[ENSEMBLE_AXIS]
+    if ensemble % e_dev != 0:
+        raise ValueError(f"E={ensemble} not divisible by ensemble mesh size {e_dev}")
+    if PEER_AXIS in mesh.axis_names and n % mesh.shape[PEER_AXIS] != 0:
+        raise ValueError(
+            f"N={n} not divisible by peer mesh size {mesh.shape[PEER_AXIS]}"
+        )
+
+
+def shard_fleet(fleet: FleetState, mesh: Mesh) -> FleetState:
+    """Place a FleetState on the mesh (ensemble axis split; rows too on a
+    2-D ``E x peers`` mesh)."""
+    _check_fleet_divisible(fleet.ensemble, fleet.n, mesh)
+    peers = PEER_AXIS in mesh.axis_names
+    return jax.device_put(fleet, _named(mesh, fleet_state_specs(fleet, peers)))
+
+
+def shard_fleet_inputs(
+    inputs: TickInputs, mesh: Mesh, stacked: bool = False
+) -> TickInputs:
+    """Place fleet TickInputs on the mesh (``stacked=True`` for [T, E, ...])."""
+    peers = PEER_AXIS in mesh.axis_names
+    specs = fleet_inputs_specs(
+        stacked=stacked, with_drop_ok=inputs.drop_ok is not None, peers_sharded=peers
+    )
+    return jax.device_put(inputs, _named(mesh, specs))
+
+
+def make_sharded_fleet_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
+    """Vmapped tick whose output carry is constrained back onto the mesh
+    layout — the fleet twin of ``parallel.mesh.make_sharded_tick`` (stable
+    per-tick partitioning under scan/while_loop)."""
+    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+    peers = PEER_AXIS in mesh.axis_names
+
+    def sharded_tick(st: MeshState, inp: TickInputs):
+        st, m = vtick(st, inp)
+        # Specs derived from the (traced) carry itself, so the optional
+        # fields' presence always matches the tree structure (the same
+        # contract as parallel.mesh.make_sharded_tick).
+        specs = jax.tree.map(
+            lambda s: _stacked(s, peers),
+            state_specs(st),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        st = jax.tree.map(jax.lax.with_sharding_constraint, st, _named(mesh, specs))
+        return st, m
+
+    return sharded_tick
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "faulty"))
+def simulate_fleet_sharded(
+    fleet: FleetState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    mesh: Mesh,
+    faulty: bool = True,
+):
+    """Sharded twin of :func:`kaboodle_tpu.fleet.simulate_fleet`."""
+    tick = make_sharded_fleet_tick(cfg, mesh, faulty=faulty)
+    new_mesh, metrics = jax.lax.scan(tick, fleet.mesh, inputs)
+    return dataclasses.replace(fleet, mesh=new_mesh), metrics
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_ticks", "faulty"))
+def run_fleet_until_converged_sharded(
+    fleet: FleetState,
+    cfg: SwimConfig,
+    mesh: Mesh,
+    max_ticks: int = 64,
+    faulty: bool = False,
+):
+    """Sharded twin of :func:`kaboodle_tpu.fleet.run_fleet_until_converged`.
+
+    The masked convergence loop's fleet-wide ``any(~done)`` predicate is the
+    only per-iteration cross-ensemble reduction — on a 1-D ensemble mesh the
+    whole tick body partitions collective-free.
+    """
+    tick = make_sharded_fleet_tick(cfg, mesh, faulty=faulty)
+    peers = PEER_AXIS in mesh.axis_names
+    idle = fleet_idle_inputs(fleet.n, fleet.ensemble, drop_rate=fleet.drop_rate)
+    idle = jax.tree.map(
+        jax.lax.with_sharding_constraint,
+        idle,
+        _named(mesh, fleet_inputs_specs(peers_sharded=peers)),
+    )
+    new_mesh, conv_tick, done = fleet_converge_loop(fleet.mesh, tick, idle, max_ticks)
+    return dataclasses.replace(fleet, mesh=new_mesh), conv_tick, done

@@ -1,0 +1,156 @@
+"""On-device Monte Carlo statistics over the ensemble axis.
+
+Everything here is a reduction over ``[E]`` (or ``[T, E]``) device arrays —
+quantiles, survival curves, trajectory envelopes, per-knob marginals — so a
+million-member sweep hands the host a handful of scalars, never E per-member
+round-trips (the memoized-sweep lesson of arXiv:2602.10615: the statistic is
+the product, the trajectories are intermediates). Inputs are exactly what
+the fleet runners emit: ``conv_tick``/``converged`` ``[E]`` vectors from
+:func:`kaboodle_tpu.fleet.run_fleet_until_converged`, stacked ``TickMetrics``
+(leaves ``[T, E]``) from :func:`kaboodle_tpu.fleet.simulate_fleet`, and the
+per-member knob vector the sweep assigned.
+
+Quantile semantics: linear interpolation over the sorted converged subset
+(NumPy's default ``np.quantile`` method), computed in float32 on device;
+``NaN`` where no member converged. tests/test_fleet.py pins every reduction
+against a NumPy host recompute.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.sim.state import TickMetrics
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _masked_quantiles(values: jax.Array, mask: jax.Array, qs: tuple) -> jax.Array:
+    """Linear-interpolation quantiles of ``values[mask]``, float32 [len(qs)].
+
+    Sort with masked-out entries pushed to +inf position, then interpolate
+    between the two order statistics bracketing ``q * (m - 1)`` where m is
+    the mask count — NumPy's default method on the selected subset. NaN when
+    the mask is empty.
+    """
+    e = values.shape[0]
+    s = jnp.sort(jnp.where(mask, values, jnp.int32(_I32MAX))).astype(jnp.float32)
+    m = jnp.sum(mask, dtype=jnp.int32)
+    q = jnp.asarray(qs, dtype=jnp.float32)
+    pos = q * jnp.maximum(m - 1, 0).astype(jnp.float32)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, jnp.maximum(m - 1, 0))
+    frac = pos - lo.astype(jnp.float32)
+    lo = jnp.clip(lo, 0, e - 1)
+    hi = jnp.clip(hi, 0, e - 1)
+    v = s[lo] * (1.0 - frac) + s[hi] * frac
+    return jnp.where(m > 0, v, jnp.float32(jnp.nan))
+
+
+@functools.partial(jax.jit, static_argnames=("qs",))
+def convergence_quantiles(
+    conv_tick: jax.Array,
+    converged: jax.Array,
+    qs: tuple = (0.5, 0.9, 0.99),
+) -> jax.Array:
+    """Quantiles of the convergence-tick distribution over converged members.
+
+    float32 ``[len(qs)]``; NaN where nothing converged. One sort + gathers
+    on device — the headline numbers of a sweep.
+    """
+    return _masked_quantiles(conv_tick, converged, qs)
+
+
+@functools.partial(jax.jit, static_argnames=("max_ticks",))
+def survival_curve(
+    conv_tick: jax.Array,
+    converged: jax.Array,
+    max_ticks: int,
+) -> jax.Array:
+    """Fraction of members still unconverged after each tick.
+
+    float32 ``[max_ticks + 1]``: entry t is P(member unconverged after t
+    ticks) — 1.0 at t=0 (convergence is judged end-of-tick, so ``conv_tick
+    >= 1``), stepping down toward the never-converged fraction.
+    """
+    e = conv_tick.shape[0]
+    t = jnp.arange(max_ticks + 1, dtype=jnp.int32)
+    unconv = ~converged[None, :] | (conv_tick[None, :] > t[:, None])
+    return jnp.sum(unconv, axis=-1, dtype=jnp.float32) / jnp.float32(e)
+
+
+@jax.jit
+def agree_fraction_trajectory(metrics: TickMetrics) -> dict:
+    """Ensemble envelope of the agree-fraction trajectory.
+
+    From stacked fleet metrics (leaves ``[T, E]``): the per-tick mean, min,
+    and max over members of ``agree_fraction``, plus the per-tick converged
+    member fraction — each float32 ``[T]``. The on-device summary of "how
+    does agreement build" across the whole ensemble.
+    """
+    af = metrics.agree_fraction
+    e = af.shape[-1]
+    return {
+        "mean": jnp.mean(af, axis=-1),
+        "min": jnp.min(af, axis=-1),
+        "max": jnp.max(af, axis=-1),
+        "converged_fraction": jnp.sum(metrics.converged, axis=-1, dtype=jnp.float32)
+        / jnp.float32(e),
+    }
+
+
+@jax.jit
+def knob_marginals(
+    knob: jax.Array,
+    values: jax.Array,
+    conv_tick: jax.Array,
+    converged: jax.Array,
+) -> dict:
+    """Per-knob-value marginals of the convergence outcome.
+
+    ``knob`` float32 ``[E]`` is each member's knob setting; ``values``
+    float32 ``[B]`` the sweep grid (members are assigned grid points
+    exactly, so membership is an equality test — fleet/bench.py's layout).
+    Returns per-bin device vectors (``[B]``): member count, converged
+    fraction, and mean convergence tick over the bin's converged members
+    (NaN where none converged). One one-hot contraction, no host loop.
+    """
+    onehot = knob[:, None] == values[None, :]  # [E, B]
+    members = jnp.sum(onehot, axis=0, dtype=jnp.int32)
+    conv_hot = onehot & converged[:, None]
+    conv_count = jnp.sum(conv_hot, axis=0, dtype=jnp.int32)
+    tick_sum = jnp.sum(
+        jnp.where(conv_hot, conv_tick[:, None], 0).astype(jnp.float32), axis=0
+    )
+    mean_tick = jnp.where(
+        conv_count > 0,
+        tick_sum / jnp.maximum(conv_count, 1).astype(jnp.float32),
+        jnp.float32(jnp.nan),
+    )
+    frac = conv_count.astype(jnp.float32) / jnp.maximum(members, 1).astype(jnp.float32)
+    return {
+        "members": members,
+        "converged_fraction": frac,
+        "mean_conv_tick": mean_tick,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("qs",))
+def knob_quantiles(
+    knob: jax.Array,
+    values: jax.Array,
+    conv_tick: jax.Array,
+    converged: jax.Array,
+    qs: tuple = (0.5, 0.9, 0.99),
+) -> jax.Array:
+    """Convergence-tick quantiles per knob value: float32 ``[B, len(qs)]``.
+
+    The per-bin twin of :func:`convergence_quantiles`, vmapped over the
+    sweep grid — the body of the sweep CLI's quantile table.
+    """
+    return jax.vmap(
+        lambda v: _masked_quantiles(conv_tick, converged & (knob == v), qs)
+    )(values)

@@ -120,7 +120,12 @@ def state_specs(state: MeshState | None = None) -> MeshState:
     when present in ``state`` — a ``None`` leaf is an *empty subtree* in a
     pytree, so the spec tree's structure must mirror the state's exactly or
     every tree-mapped placement/constraint raises. With no ``state`` given,
-    both optional fields are assumed present (the default ``init_state``)."""
+    both optional fields are assumed present (the default ``init_state``).
+
+    Single source of truth for MeshState placement: the fleet layer
+    (kaboodle_tpu/fleet/sharding.py) derives its stacked-``[E]`` specs by
+    transforming these (ensemble axis prepended), so a new MeshState field
+    added here is automatically placed correctly fleet-wide."""
     row2 = P(PEER_AXIS, None)
     row1 = P(PEER_AXIS)
     rep = P()

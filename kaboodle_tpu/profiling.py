@@ -66,6 +66,51 @@ def tick_stats(metrics: TickMetrics) -> np.ndarray:
     return out
 
 
+def fleet_tick_stats(metrics: TickMetrics, member: int) -> np.ndarray:
+    """One ensemble member's per-tick table from fleet-stacked metrics.
+
+    ``metrics`` comes from :func:`kaboodle_tpu.fleet.simulate_fleet` (leaves
+    ``[T, E]``); this slices member ``member`` and delegates to
+    :func:`tick_stats`. For whole-ensemble statistics use the on-device
+    reductions in ``kaboodle_tpu.fleet.stats`` — slicing E members through
+    here is exactly the per-member host round-trip the fleet design avoids.
+    """
+    import jax
+
+    return tick_stats(jax.tree.map(lambda a: a[:, member], metrics))
+
+
+def fleet_run_stats(metrics: TickMetrics) -> np.ndarray:
+    """Per-tick ensemble summary of a fleet scan, as a NumPy record table.
+
+    One row per tick: converged-member count and the agree-fraction
+    mean/min over the ensemble — the host-side rendering of
+    ``kaboodle_tpu.fleet.stats.agree_fraction_trajectory`` for quick
+    inspection (the reductions run on device; only [T]-vectors land here).
+    """
+    from kaboodle_tpu.fleet.stats import agree_fraction_trajectory
+
+    traj = agree_fraction_trajectory(metrics)
+    ticks = np.asarray(traj["mean"]).shape[0]
+    ensemble = np.asarray(metrics.converged).shape[-1]
+    out = np.zeros(
+        ticks,
+        dtype=[
+            ("tick", np.int32),
+            ("converged_members", np.int32),
+            ("agree_fraction_mean", np.float32),
+            ("agree_fraction_min", np.float32),
+        ],
+    )
+    out["tick"] = np.arange(ticks)
+    out["converged_members"] = np.round(
+        np.asarray(traj["converged_fraction"]) * ensemble
+    ).astype(np.int32)
+    out["agree_fraction_mean"] = np.asarray(traj["mean"])
+    out["agree_fraction_min"] = np.asarray(traj["min"])
+    return out
+
+
 def log_run(metrics: TickMetrics, emit=print) -> None:
     """Per-tick one-liners (the RUST_LOG=debug analogue, main.rs:54-58)."""
     for row in tick_stats(metrics):

@@ -101,16 +101,23 @@ def make_chunked_tick_fn(
     out the random-drop resident entirely — callers that guarantee
     ``drop_rate == 0`` (the at-scale proofs) use it to avoid materializing
     an [N, N] gate matrix; an explicit ``inp.drop_ok`` still applies.
+    With ``drop=True`` the per-block uniform draws are gated on
+    ``drop_rate > 0`` in-graph (zero-rate ticks skip the RNG sweep), but the
+    [N, N] bool gate resident itself is part of the compiled program
+    (~4 GiB at N=65,536) — the advertised O(block·N) transient bound
+    requires ``drop=False``.
     The Pallas stage kernels and the fast/slow split do not apply here
     (this path is its own memory-bound formulation); every other config
     flag behaves exactly as in ``make_tick_fn``.
 
     ``boot_union=True`` replaces the O(N^3) join-gossip contraction with
     its closed form for the fresh broadcast-boot avalanche. PRECONDITION
-    (caller-owned, tested, NOT checked in-graph): a fault-free tick
-    (everyone alive, no drop/partition input) whose start-of-round
-    membership maps are exactly the singletons {self} — i.e. tick 0 of a
-    broadcast boot from ``init_state(ring_contacts=0)``. There,
+    (caller-owned, tested, NOT checked in-graph beyond the build-time
+    ``faulty`` guard below): a FAULT-FREE tick (everyone alive, no
+    drop/partition input — ``faulty=True`` is therefore never valid with it
+    and raises at build time) whose start-of-round membership maps are
+    exactly the singletons {self} — i.e. tick 0 of a broadcast boot from
+    ``init_state(ring_contacts=0)``. There,
     ``member_a == eye`` collapses the share term to ``reply_del.T`` and
     the joiner-prefix term to a reply-count comparison:
 
@@ -125,6 +132,14 @@ def make_chunked_tick_fn(
     """
 
     det = cfg.deterministic
+    if boot_union and faulty:
+        # The closed form assumes every Join delivers everywhere (no drop /
+        # partition / dead peers); a faulty build can never satisfy that, so
+        # this combination is silently-wrong-by-construction (ADVICE r5).
+        raise ValueError(
+            "boot_union=True requires faulty=False: the closed-form join "
+            "union assumes fault-free delivery on the boot tick"
+        )
 
     # Traced from other modules (jit call sites in the scale-proof scripts
     # and tests) — same pragma rationale as kernel.py's tick.
@@ -230,7 +245,18 @@ def make_chunked_tick_fn(
                         jax.random.fold_in(key_drop, bi), (block, n))
                     return u >= inp.drop_rate
 
-                drop_mat = pmap_blocks(_drop_rows)
+                # Gate the per-block uniform draws on the (traced) rate, as
+                # kernel.py does: a drop=True caller running a zero-rate tick
+                # (churn/partition-only schedules) skips the RNG sweep and its
+                # float temporaries entirely. The [N, N] bool resident itself
+                # is a property of the drop=True build (the cond's all-True
+                # branch still produces it) — callers that need the module's
+                # advertised O(block*N) bound must pass drop=False.
+                drop_mat = jax.lax.cond(
+                    inp.drop_rate > 0,
+                    lambda: pmap_blocks(_drop_rows),
+                    lambda: jnp.ones((n, n), dtype=bool),
+                )
             else:
                 drop_mat = None
 

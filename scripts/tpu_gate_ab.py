@@ -145,8 +145,10 @@ def main() -> None:
     else:
         # A stale worktree from an earlier run would silently corrupt the
         # pregate arm: force-checkout the pinned rev (covers both HEAD drift
-        # and dirty tracked files); recreate the worktree if its metadata is
-        # broken (pruned/moved).
+        # and dirty tracked files) AND clean untracked artifacts — the
+        # checkout alone leaves stale .pyc/__pycache__/generated results in
+        # place (ADVICE r5); recreate the worktree if its metadata is broken
+        # (pruned/moved) or the clean fails.
         pinned = subprocess.run(
             ["git", "rev-parse", PRE_GATE_REF], cwd=REPO_ROOT,
             capture_output=True, text=True, check=True,
@@ -155,6 +157,11 @@ def main() -> None:
             ["git", "checkout", "--force", "--detach", pinned],
             cwd=WORKTREE, capture_output=True, text=True,
         )
+        if reset.returncode == 0:
+            reset = subprocess.run(
+                ["git", "clean", "-fdx"],
+                cwd=WORKTREE, capture_output=True, text=True,
+            )
         if reset.returncode != 0:
             import shutil
 

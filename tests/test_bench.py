@@ -45,3 +45,25 @@ def test_bench_detection_section():
     assert r["first_removal_tick"] is not None
     assert r["detection_complete_tick"] is not None
     assert r["within_bound"], r
+
+
+def test_is_size_ceiling_matches_http_500_only():
+    """The step-down trigger must catch the remote-compile helper's HTTP 500
+    but NOT a real compile bug whose text merely contains the digits 500
+    (a shape dim / line number) — that must surface as a traceback
+    (ADVICE r5)."""
+    from bench import _is_size_ceiling
+
+    # Real triggers: OOM shapes, the helper by name, status-shaped 500s.
+    assert _is_size_ceiling(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert _is_size_ceiling(RuntimeError("tpu_compile_helper: request failed"))
+    assert _is_size_ceiling(RuntimeError("remote compile failed: HTTP 500"))
+    assert _is_size_ceiling(RuntimeError("compile request status: 500"))
+    assert _is_size_ceiling(
+        RuntimeError("compile: 500 Internal Server Error"))
+    # Non-triggers: 500 as a shape / line number in a compile error.
+    assert not _is_size_ceiling(
+        RuntimeError("XLA compile error: dot shape f32[500,512] mismatch"))
+    assert not _is_size_ceiling(
+        RuntimeError("failed to compile kernel.py:500: bad operand"))
+    assert not _is_size_ceiling(RuntimeError("HTTP 500 from unrelated service"))

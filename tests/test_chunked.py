@@ -200,3 +200,11 @@ def test_chunked_random_drop_converges():
     alive = np.asarray(sb.alive)
     assert not state[alive][:, 5].any()
     assert bool(np.asarray(m.converged))
+
+
+def test_boot_union_rejects_faulty_build():
+    """boot_union's closed form assumes fault-free delivery on the boot
+    tick; combining it with the faulty build is never valid and must fail
+    at build time, not silently produce wrong gossip (ADVICE r5)."""
+    with pytest.raises(ValueError, match="boot_union"):
+        make_chunked_tick_fn(SwimConfig(), faulty=True, boot_union=True)

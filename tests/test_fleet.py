@@ -1,0 +1,408 @@
+"""Fleet (batched ensemble) parity, masking, statistics, and lint gates.
+
+The fleet contract is inheritance: member k of a fleet is BIT-EXACT with a
+standalone single-mesh run seeded ``seeds[k]`` (dense and sharded), so every
+parity guarantee the single-mesh kernel has (PARITY.md, the oracle pins)
+extends to the whole ensemble by sampling members. The masked convergence
+loop must freeze each member at exactly its convergence tick, and the stats
+layer's device reductions must match NumPy host recomputes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.fleet import (
+    fleet_idle_inputs,
+    init_fleet,
+    make_fleet_mesh,
+    make_fleet_tick_fn,
+    member_state,
+    run_fleet_until_converged,
+    run_fleet_until_converged_sharded,
+    shard_fleet,
+    shard_fleet_inputs,
+    simulate_fleet,
+    simulate_fleet_sharded,
+)
+from kaboodle_tpu.fleet.stats import (
+    convergence_quantiles,
+    knob_marginals,
+    knob_quantiles,
+    survival_curve,
+)
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import run_until_converged, simulate
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+
+def _assert_states_equal(a, b, ctx=""):
+    for name in ("state", "timer", "alive", "identity", "never_broadcast",
+                 "last_broadcast", "kpr_partner", "kpr_fp", "kpr_n", "tick",
+                 "key"):
+        assert jnp.array_equal(getattr(a, name), getattr(b, name)), (ctx, name)
+    for name in ("latency", "id_view"):
+        va, vb = getattr(a, name), getattr(b, name)
+        assert (va is None) == (vb is None), (ctx, name)
+        if va is not None:
+            assert jnp.array_equal(va, vb, equal_nan=True), (ctx, name)
+
+
+@pytest.fixture(scope="module")
+def emesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return make_fleet_mesh()
+
+
+# ---------------------------------------------------------------------------
+# member parity — dense
+
+
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_fleet_member_matches_single_mesh_dense(deterministic):
+    """Every member of a scanned fleet equals the standalone run bit-exactly
+    (state AND metrics), in both protocol-draw modes."""
+    n, e, ticks = 32, 4, 8
+    cfg = SwimConfig(deterministic=deterministic)
+    fleet = init_fleet(n, e)
+    out, m = simulate_fleet(fleet, fleet_idle_inputs(n, e, ticks=ticks), cfg,
+                            faulty=False)
+    for k in range(e):
+        ref, rm = simulate(init_state(n, seed=k), idle_inputs(n, ticks=ticks),
+                           cfg, faulty=False)
+        _assert_states_equal(ref, member_state(out, k), ctx=f"member {k}")
+        assert jnp.array_equal(rm.converged, m.converged[:, k])
+        assert jnp.array_equal(rm.messages_delivered, m.messages_delivered[:, k])
+        assert jnp.array_equal(rm.fingerprint_min, m.fingerprint_min[:, k])
+        assert jnp.array_equal(rm.agree_fraction, m.agree_fraction[:, k])
+
+
+def test_fleet_member_matches_single_mesh_faulty_drop():
+    """Per-member drop_rate knobs through the faulty vmapped kernel: each
+    member's trajectory equals a standalone faulty run fed the same scalar
+    rate (same seed => same key_drop stream => same [N, N] draws)."""
+    n, e, ticks = 24, 3, 6
+    cfg = SwimConfig()
+    rates = jnp.asarray([0.0, 0.15, 0.4], dtype=jnp.float32)
+    fleet = init_fleet(n, e, drop_rates=rates)
+    inp = fleet_idle_inputs(n, e, ticks=ticks, drop_rate=rates)
+    out, m = simulate_fleet(fleet, inp, cfg, faulty=True)
+    for k in range(e):
+        sin = idle_inputs(n, ticks=ticks)
+        sin = dataclasses.replace(
+            sin, drop_rate=jnp.full((ticks,), rates[k], dtype=jnp.float32))
+        ref, rm = simulate(init_state(n, seed=k), sin, cfg, faulty=True)
+        _assert_states_equal(ref, member_state(out, k), ctx=f"member {k}")
+        assert jnp.array_equal(rm.messages_delivered, m.messages_delivered[:, k])
+
+
+# ---------------------------------------------------------------------------
+# masked convergence loop
+
+
+def test_masked_converge_loop_stops_late_members():
+    """Members converge at different ticks (epidemic boot, per-seed draws);
+    each must freeze at exactly its own convergence tick — conv_tick and
+    final state bit-equal to the standalone convergence run."""
+    n, e, max_ticks = 32, 8, 64
+    cfg = SwimConfig(join_broadcast_enabled=False, backdate_gossip_inserts=False)
+    fleet = init_fleet(n, e, ring_contacts=2)
+    out, conv_tick, done = run_fleet_until_converged(fleet, cfg,
+                                                     max_ticks=max_ticks)
+    ct = np.asarray(conv_tick)
+    assert bool(np.asarray(done).all())
+    # The masking must actually have engaged: an all-equal ensemble would
+    # not exercise the freeze (the per-seed epidemic boots do diverge).
+    assert np.unique(ct).size >= 2, ct
+    for k in range(e):
+        ref, ticks_run, conv = run_until_converged(
+            init_state(n, seed=k, ring_contacts=2), cfg, max_ticks=max_ticks)
+        assert bool(conv)
+        assert int(ticks_run) == ct[k], (k, int(ticks_run), ct[k])
+        _assert_states_equal(ref, member_state(out, k), ctx=f"member {k}")
+
+
+def test_converge_loop_unconverged_members_run_to_max_ticks():
+    """A member that never converges ticks to max_ticks (like the standalone
+    loop) and reports conv_tick == max_ticks with done == False."""
+    n, e, max_ticks = 16, 2, 4
+    cfg = SwimConfig(join_broadcast_enabled=False)  # Q6 boot: slow by design
+    fleet = init_fleet(n, e, ring_contacts=1)
+    out, conv_tick, done = run_fleet_until_converged(fleet, cfg,
+                                                     max_ticks=max_ticks)
+    assert not bool(np.asarray(done).any())
+    assert np.array_equal(np.asarray(conv_tick), [max_ticks] * e)
+    for k in range(e):
+        ref, ticks_run, conv = run_until_converged(
+            init_state(n, seed=k, ring_contacts=1), cfg, max_ticks=max_ticks)
+        assert not bool(conv) and int(ticks_run) == max_ticks
+        _assert_states_equal(ref, member_state(out, k), ctx=f"member {k}")
+
+
+def test_fleet_drop_knob_converges_through_faulty_loop():
+    """The faulty masked loop with a per-member drop grid: every member's
+    frozen state matches a standalone faulty tick-by-tick loop with the
+    same scalar rate, stopped at its own convergence."""
+    n, e, max_ticks = 24, 4, 48
+    cfg = SwimConfig()
+    rates = jnp.asarray([0.0, 0.05, 0.1, 0.2], dtype=jnp.float32)
+    fleet = init_fleet(n, e, drop_rates=rates)
+    out, conv_tick, done = run_fleet_until_converged(
+        fleet, cfg, max_ticks=max_ticks, faulty=True)
+    ct, dn = np.asarray(conv_tick), np.asarray(done)
+    tick = jax.jit(make_tick_fn(cfg, faulty=True))
+    for k in range(e):
+        st = init_state(n, seed=k)
+        idle = dataclasses.replace(
+            idle_inputs(n), drop_rate=jnp.asarray(rates[k], dtype=jnp.float32))
+        i, conv = 0, False
+        while not conv and i < max_ticks:
+            st, m = tick(st, idle)
+            i, conv = i + 1, bool(m.converged)
+        assert conv == bool(dn[k]), k
+        assert i == ct[k], (k, i, ct[k])
+        _assert_states_equal(st, member_state(out, k), ctx=f"member {k}")
+
+
+# ---------------------------------------------------------------------------
+# member parity — sharded
+
+
+@pytest.mark.slow
+def test_fleet_member_matches_single_mesh_sharded(emesh8):
+    """1-D ensemble mesh: members split across 8 devices, each bit-equal to
+    the standalone run; leaves actually carry the ensemble sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kaboodle_tpu.fleet import ENSEMBLE_AXIS
+
+    n, e, ticks = 16, 8, 8
+    cfg = SwimConfig()
+    fleet = shard_fleet(init_fleet(n, e), emesh8)
+    want = NamedSharding(emesh8, P(ENSEMBLE_AXIS, None, None))
+    assert fleet.mesh.state.sharding.is_equivalent_to(want, 3)
+    inp = shard_fleet_inputs(fleet_idle_inputs(n, e, ticks=ticks), emesh8,
+                             stacked=True)
+    out, m = simulate_fleet_sharded(fleet, inp, cfg, emesh8, faulty=False)
+    assert len(out.mesh.state.sharding.device_set) == 8
+    for k in (0, 3, 7):
+        ref, rm = simulate(init_state(n, seed=k), idle_inputs(n, ticks=ticks),
+                           cfg, faulty=False)
+        _assert_states_equal(ref, member_state(out, k), ctx=f"member {k}")
+        assert jnp.array_equal(rm.converged, m.converged[:, k])
+
+
+@pytest.mark.slow
+def test_fleet_2d_mesh_converge_matches_dense():
+    """E x peers 2-D mesh: the masked convergence loop partitioned over both
+    axes equals the dense fleet run bit-exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    n, e, max_ticks = 16, 4, 64
+    cfg = SwimConfig(join_broadcast_enabled=False, backdate_gossip_inserts=False)
+    mesh2 = make_fleet_mesh(4, 2)
+    assert mesh2.axis_names == ("ensemble", "peers")
+    fl = shard_fleet(init_fleet(n, e, ring_contacts=2), mesh2)
+    sh, ct_sh, done_sh = run_fleet_until_converged_sharded(
+        fl, cfg, mesh2, max_ticks=max_ticks)
+    dn, ct_dn, done_dn = run_fleet_until_converged(
+        init_fleet(n, e, ring_contacts=2), cfg, max_ticks=max_ticks)
+    assert np.array_equal(np.asarray(ct_sh), np.asarray(ct_dn))
+    assert np.array_equal(np.asarray(done_sh), np.asarray(done_dn))
+    _assert_states_equal(sh.mesh, dn.mesh, ctx="2d-mesh fleet")
+
+
+def test_fleet_shard_divisibility_checks(emesh8):
+    with pytest.raises(ValueError):
+        shard_fleet(init_fleet(16, 6), emesh8)  # E=6 not divisible by 8
+    with pytest.raises(ValueError):
+        make_fleet_mesh(7, 2)  # 14 > 8 devices
+
+
+# ---------------------------------------------------------------------------
+# acceptance scale
+
+
+def test_fleet_acceptance_e256_n256_single_dispatch():
+    """ISSUE 2 acceptance: an E=256, N=256 fault-free ensemble converges in
+    ONE run_fleet_until_converged dispatch on CPU, member 0 bit-exact
+    against the standalone convergence run."""
+    n = e = 256
+    cfg = SwimConfig()
+    fleet = init_fleet(n, e, track_latency=False, instant_identity=True)
+    out, conv_tick, done = run_fleet_until_converged(fleet, cfg, max_ticks=16)
+    assert bool(np.asarray(done).all())
+    ref, ticks_run, conv = run_until_converged(
+        init_state(n, seed=0, track_latency=False, instant_identity=True),
+        cfg, max_ticks=16)
+    assert bool(conv)
+    assert int(ticks_run) == int(np.asarray(conv_tick)[0])
+    _assert_states_equal(ref, member_state(out, 0), ctx="member 0")
+    q = np.asarray(convergence_quantiles(conv_tick, done, qs=(0.5, 0.99)))
+    assert np.all(q >= 1)
+
+
+# ---------------------------------------------------------------------------
+# stats vs NumPy recompute
+
+
+def test_convergence_quantiles_match_numpy():
+    rng = np.random.default_rng(0)
+    ct = rng.integers(1, 100, size=257).astype(np.int32)
+    conv = rng.random(257) < 0.8
+    qs = (0.1, 0.5, 0.9, 0.99)
+    got = np.asarray(convergence_quantiles(jnp.asarray(ct), jnp.asarray(conv),
+                                           qs=qs))
+    want = np.quantile(ct[conv].astype(np.float32), qs)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # empty mask -> NaN
+    none = np.asarray(convergence_quantiles(
+        jnp.asarray(ct), jnp.zeros((257,), dtype=bool), qs=qs))
+    assert np.all(np.isnan(none))
+
+
+def test_survival_curve_matches_numpy():
+    rng = np.random.default_rng(1)
+    max_ticks = 40
+    ct = rng.integers(1, max_ticks + 1, size=128).astype(np.int32)
+    conv = rng.random(128) < 0.7
+    got = np.asarray(survival_curve(jnp.asarray(ct), jnp.asarray(conv),
+                                    max_ticks=max_ticks))
+    t = np.arange(max_ticks + 1)
+    want = np.mean(~conv[None, :] | (ct[None, :] > t[:, None]), axis=1)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-6)
+    assert got[0] == 1.0  # convergence is end-of-tick: nothing done at t=0
+    np.testing.assert_allclose(got[-1], np.mean(~conv), rtol=1e-6)
+
+
+def test_knob_marginals_and_quantiles_match_numpy():
+    rng = np.random.default_rng(2)
+    values = np.linspace(0.0, 0.3, 4, dtype=np.float32)
+    knob = np.repeat(values, 32)
+    ct = rng.integers(1, 64, size=128).astype(np.int32)
+    conv = rng.random(128) < 0.75
+    marg = knob_marginals(jnp.asarray(knob), jnp.asarray(values),
+                          jnp.asarray(ct), jnp.asarray(conv))
+    kq = np.asarray(knob_quantiles(jnp.asarray(knob), jnp.asarray(values),
+                                   jnp.asarray(ct), jnp.asarray(conv),
+                                   qs=(0.5, 0.9)))
+    for b, v in enumerate(values):
+        sel = knob == v
+        assert int(np.asarray(marg["members"])[b]) == sel.sum()
+        np.testing.assert_allclose(
+            float(np.asarray(marg["converged_fraction"])[b]),
+            conv[sel].mean(), rtol=1e-6)
+        sub = ct[sel & conv]
+        if sub.size:
+            np.testing.assert_allclose(
+                float(np.asarray(marg["mean_conv_tick"])[b]), sub.mean(),
+                rtol=1e-5)
+            np.testing.assert_allclose(
+                kq[b], np.quantile(sub.astype(np.float32), (0.5, 0.9)),
+                rtol=1e-5)
+
+
+def test_agree_fraction_trajectory_shapes():
+    from kaboodle_tpu.fleet import agree_fraction_trajectory
+    from kaboodle_tpu.profiling import fleet_run_stats, fleet_tick_stats
+
+    n, e, ticks = 16, 3, 5
+    cfg = SwimConfig()
+    fleet = init_fleet(n, e)
+    _, m = simulate_fleet(fleet, fleet_idle_inputs(n, e, ticks=ticks), cfg,
+                          faulty=False)
+    traj = agree_fraction_trajectory(m)
+    for key in ("mean", "min", "max", "converged_fraction"):
+        assert traj[key].shape == (ticks,)
+    assert np.all(np.asarray(traj["min"]) <= np.asarray(traj["mean"]) + 1e-6)
+    table = fleet_run_stats(m)
+    assert table.shape == (ticks,) and table["converged_members"][-1] == e
+    one = fleet_tick_stats(m, 1)
+    ref, rm = simulate(init_state(n, seed=1), idle_inputs(n, ticks=ticks),
+                       cfg, faulty=False)
+    assert np.array_equal(one["converged"], np.asarray(rm.converged))
+
+
+# ---------------------------------------------------------------------------
+# construction / validation / lint
+
+
+def test_init_fleet_validation_and_pallas_guard():
+    with pytest.raises(ValueError):
+        init_fleet(16, 0)
+    with pytest.raises(ValueError):
+        init_fleet(16, 4, seeds=jnp.arange(3))
+    with pytest.raises(ValueError):
+        init_fleet(16, 4, drop_rates=jnp.zeros((2,)))
+    with pytest.raises(ValueError):
+        make_fleet_tick_fn(SwimConfig(use_pallas_fp=True), faulty=False)
+
+
+def test_init_fleet_keys_match_standalone_seeds():
+    fleet = init_fleet(8, 3, seeds=jnp.asarray([5, 9, 2]))
+    for k, seed in enumerate([5, 9, 2]):
+        assert jnp.array_equal(member_state(fleet, k).key,
+                               jax.random.PRNGKey(seed)), k
+
+
+def test_sweep_cli_emits_quantile_table(capsys):
+    """One process invocation of the sweep front-end yields the per-knob
+    quantile table and the compact JSON tail line."""
+    import json
+
+    from kaboodle_tpu.fleet.bench import build_parser, run_sweep
+
+    args = build_parser().parse_args(
+        ["--sweep", "drop_rate=0:0.1:2", "--ensemble", "8", "--n", "16",
+         "--max-ticks", "24", "--shard", "none"])
+    line = run_sweep(args)
+    out = capsys.readouterr().out
+    assert "drop_rate=0.000" in out and "p50" in out
+    assert line["metric"] == "fleet_convergence_quantiles"
+    assert line["ensemble"] == 8 and len(line["per_knob"]) == 2
+    assert line["per_knob"][0]["converged_fraction"] == 1.0
+    json.dumps(line)  # the tail line must be JSON-serializable
+
+
+def test_sweep_cli_rejects_bad_flag_combinations():
+    """Contradictory or under-provisioned sweeps must refuse, not silently
+    measure something else (code-review findings on the first cut)."""
+    import pytest as _pytest
+
+    from kaboodle_tpu.fleet.bench import build_parser, run_sweep
+
+    with _pytest.raises(SystemExit, match="mutually exclusive"):
+        run_sweep(build_parser().parse_args(
+            ["--sweep", "drop_rate=0:0.1:2", "--seeds-only", "--ensemble", "4"]))
+    with _pytest.raises(SystemExit, match="grid point"):
+        run_sweep(build_parser().parse_args(
+            ["--sweep", "drop_rate=0:0.1:8", "--ensemble", "4"]))
+    with _pytest.raises(SystemExit, match="bad --sweep"):
+        run_sweep(build_parser().parse_args(
+            ["--sweep", "drop_rate=0:0.1", "--ensemble", "4"]))
+    with _pytest.raises(SystemExit, match="unknown sweep knob"):
+        run_sweep(build_parser().parse_args(
+            ["--sweep", "ping_timeout_ticks=1:3:2", "--ensemble", "4"]))
+
+
+def test_fleet_graftlint_clean():
+    """ISSUE 2 satellite: the fleet subsystem carries no KB2xx/KB3xx debt
+    (it is registered in the hot-path scope, so KB301/KB302 apply)."""
+    from pathlib import Path
+
+    from kaboodle_tpu.analysis import analyze_path
+    from kaboodle_tpu.analysis.core import _load_rules
+    from kaboodle_tpu.analysis.rules_hotpath import DTYPE_DISCIPLINE_FILES, HOT_DIRS
+
+    assert "kaboodle_tpu/fleet/" in HOT_DIRS
+    assert "core.py" in DTYPE_DISCIPLINE_FILES and "stats.py" in DTYPE_DISCIPLINE_FILES
+    _load_rules()
+    root = Path(__file__).resolve().parent.parent / "kaboodle_tpu" / "fleet"
+    findings = [f for p in sorted(root.glob("*.py")) for f in analyze_path(p)]
+    bad = [f for f in findings if f.rule.startswith(("KB2", "KB3"))]
+    assert not bad, [(f.path, f.rule, f.line, f.message) for f in bad]

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -51,12 +51,21 @@ ci: lint native test
 	timeout 420 $(PYTHON) __graft_entry__.py
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 	$(MAKE) fleet-dryrun
+	$(MAKE) warp-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
 fleet-dryrun:
 	timeout 300 $(PYTHON) -m kaboodle_tpu fleet --platform cpu \
 	  --sweep drop_rate=0:0.2:4 --ensemble 16 --n 32 --max-ticks 32
+
+# Warp A/B dryrun (the event-horizon fast-forward engine, kaboodle_tpu/warp)
+# at toy scale: dense-vs-leap on the sparse-fault steady-state scenario,
+# bit-exactness verified in-process, usual JSON tail. The measured >= 2x
+# acceptance run is the full-size `python bench.py --warp --platform cpu`
+# (PERF.md "Warp"); CI only proves the lane runs end-to-end.
+warp-dryrun:
+	timeout 300 $(PYTHON) bench.py --warp --platform cpu --n 256 --ticks 64
 
 # Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
 # then the every-fault-path scan, N=8192 over 8 virtual CPU devices,

@@ -1,15 +1,17 @@
 """KB3xx — hot-path rules, scoped to the tick-kernel stack.
 
-These rules only fire in ``kaboodle_tpu/sim/``, ``kaboodle_tpu/ops/``, and
-``kaboodle_tpu/fleet/`` (matched on the module path): the whole-tensor and
-chunked tick kernels, their fused Pallas stages, the sampling/hashing
-primitives they call, and the ensemble layer that vmaps/scans the tick over
-the ``[E]`` axis (where a stray host sync stalls E meshes at once and the
-on-device-statistics contract forbids per-member round-trips). That is the
-code whose per-tick cost the north-star budget (ROADMAP.md: 65,536 peers
-converging in <2s on a v5e-8) is spent on — a stray host sync or an
-accidental int64 promotion there costs more than any micro-optimization
-wins.
+These rules only fire in ``kaboodle_tpu/sim/``, ``kaboodle_tpu/ops/``,
+``kaboodle_tpu/fleet/``, and ``kaboodle_tpu/warp/`` (matched on the module
+path): the whole-tensor and chunked tick kernels, their fused Pallas stages,
+the sampling/hashing primitives they call, the ensemble layer that
+vmaps/scans the tick over the ``[E]`` axis (where a stray host sync stalls E
+meshes at once and the on-device-statistics contract forbids per-member
+round-trips), and the warp fast-forward stack (whose leap scan replaces
+whole dense-tick sequences — a host sync or promotion inside it undoes the
+very sweeps it exists to skip). That is the code whose per-tick cost the
+north-star budget (ROADMAP.md: 65,536 peers converging in <2s on a v5e-8)
+is spent on — a stray host sync or an accidental int64 promotion there
+costs more than any micro-optimization wins.
 """
 
 from __future__ import annotations
@@ -19,18 +21,27 @@ import ast
 from kaboodle_tpu.analysis.core import Finding, Module, rule
 from kaboodle_tpu.analysis.reach import shallow_exprs, walk_with_taint
 
-HOT_DIRS = ("kaboodle_tpu/sim/", "kaboodle_tpu/ops/", "kaboodle_tpu/fleet/")
+HOT_DIRS = (
+    "kaboodle_tpu/sim/",
+    "kaboodle_tpu/ops/",
+    "kaboodle_tpu/fleet/",
+    "kaboodle_tpu/warp/",
+)
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
 # MEMORY_PLAN/SEMANTICS docs commit to: the CRC/mix-hash paths (wrong dtype =
 # wrong fingerprint) and the state/timer/sampling paths (implicit defaults
 # promote, silently doubling the [N, N] residents or wrapping sentinels).
 # The fleet core/stats carry the same discipline stacked E-fold (a promoted
-# [E, N, N] resident is E times the waste); names are matched within
-# HOT_DIRS only, so e.g. analysis/core.py never collides with fleet/core.py.
+# [E, N, N] resident is E times the waste); the warp stack carries it over
+# whole leaped spans (a promoted score carry or a wrapped int16 sentinel
+# breaks bit-exactness with the dense kernel, the subsystem's entire
+# contract). Names are matched within HOT_DIRS only, so e.g.
+# analysis/core.py never collides with fleet/core.py.
 DTYPE_DISCIPLINE_FILES = (
     "crc32.py", "hashing.py", "kernel.py", "chunked.py", "state.py", "sampling.py",
     "core.py", "stats.py",
+    "horizon.py", "leap.py", "runner.py",
 )
 
 _CONSTRUCTORS = {

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import state_converged
 from kaboodle_tpu.sim.state import (
     MeshState,
     TickInputs,
@@ -227,9 +228,12 @@ def fleet_converge_loop(
 
     Returns ``(final_mesh, conv_tick, converged)``: ``conv_tick[e]`` is the
     tick count at which member e converged (== the standalone run's
-    ``ticks_run``), ``max_ticks`` where it never did.
+    ``ticks_run``), ``max_ticks`` where it never did. Like the standalone
+    loop, agreement is also tested at entry: a member already converged at
+    tick 0 freezes immediately and reports ``conv_tick == 0``.
     """
     ensemble = mesh.alive.shape[0]
+    done0 = jax.vmap(state_converged)(mesh)
 
     def cond(carry):
         _, _, done, i = carry
@@ -255,8 +259,8 @@ def fleet_converge_loop(
         body,
         (
             mesh,
-            jnp.full((ensemble,), max_ticks, dtype=jnp.int32),
-            jnp.zeros((ensemble,), dtype=bool),
+            jnp.where(done0, 0, max_ticks).astype(jnp.int32),
+            done0,
             jnp.int32(0),
         ),
     )

@@ -135,11 +135,27 @@ def choose_among_candidates(  # graftlint: traced
     above, the fused Pallas kernel in ops/fused_oldest_k.py) — one draw per
     row, so identical keys give identical picks across formulations. Returns
     int32 ``[N]``, -1 where a row has no valid candidate."""
-    count = jnp.sum(valid, axis=-1)  # [N]
     if deterministic:
+        return pick_candidate(idx, valid, None)
+    return pick_candidate(idx, valid, jax.random.uniform(key, (idx.shape[0],)))
+
+
+def pick_candidate(  # graftlint: traced
+    idx: jax.Array,
+    valid: jax.Array,
+    u: jax.Array | None,
+) -> jax.Array:
+    """The draw-free core of :func:`choose_among_candidates`.
+
+    ``u`` is the per-row uniform sample in [0, 1) — or ``None`` for the
+    deterministic lowest-priority pick. Split out so the warp leap kernel
+    (kaboodle_tpu/warp/leap.py) can batch all k ticks' uniforms up front
+    (counter-based PRNG) and feed them back through the EXACT tail the dense
+    kernel uses: same uniform in, bit-identical target out."""
+    count = jnp.sum(valid, axis=-1)  # [N]
+    if u is None:
         choice = jnp.zeros(idx.shape[0], dtype=jnp.int32)
     else:
-        u = jax.random.uniform(key, (idx.shape[0],))
         choice = jnp.floor(u * count.astype(jnp.float32)).astype(jnp.int32)
         choice = jnp.minimum(choice, jnp.maximum(count - 1, 0))
     chosen = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]

@@ -2,10 +2,12 @@
 
 from kaboodle_tpu.parallel.mesh import (
     PEER_AXIS,
+    constrain_state,
     inputs_specs,
     make_mesh,
     make_multihost_mesh,
     make_sharded_tick,
+    row_matrix_sharding,
     run_until_converged_sharded,
     sharded_convergence_check,
     shard_inputs,
@@ -16,10 +18,12 @@ from kaboodle_tpu.parallel.mesh import (
 
 __all__ = [
     "PEER_AXIS",
+    "constrain_state",
     "inputs_specs",
     "make_mesh",
     "make_multihost_mesh",
     "make_sharded_tick",
+    "row_matrix_sharding",
     "run_until_converged_sharded",
     "sharded_convergence_check",
     "shard_inputs",

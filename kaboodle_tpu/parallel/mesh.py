@@ -188,6 +188,25 @@ def shard_inputs(inputs: TickInputs, mesh: Mesh, stacked: bool = False) -> TickI
     return jax.device_put(inputs, _named(mesh, specs))
 
 
+def constrain_state(st: MeshState, mesh: Mesh) -> MeshState:
+    """Pin a (traced) MeshState onto the mesh layout via sharding constraints.
+
+    Specs are derived from the state itself, so the optional fields'
+    presence — static at trace time — always matches the tree structure.
+    Shared by the per-tick carry constraint below and the warp runner's
+    sharded leap (kaboodle_tpu/warp/runner.py), so both programs keep the
+    same GSPMD placement."""
+    shardings = _named(mesh, state_specs(st))
+    return jax.tree.map(jax.lax.with_sharding_constraint, st, shardings)
+
+
+def row_matrix_sharding(mesh: Mesh) -> NamedSharding:
+    """The ``[N, N]`` row-sharded placement (``P('peers', None)``) as a
+    NamedSharding — the constraint the warp leap applies to its score/latency
+    scan carries so the leap partitions like the tick kernel's tensors."""
+    return NamedSharding(mesh, P(PEER_AXIS, None))
+
+
 def make_sharded_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
     """Tick fn whose output carry is constrained back onto the mesh layout.
 
@@ -197,10 +216,7 @@ def make_sharded_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
 
     def sharded_tick(st: MeshState, inp: TickInputs):
         st, m = tick(st, inp)
-        # Specs derived from the (traced) carry itself, so the optional fields'
-        # presence — static at trace time — always matches the tree structure.
-        shardings = _named(mesh, state_specs(st))
-        st = jax.tree.map(jax.lax.with_sharding_constraint, st, shardings)
+        st = constrain_state(st, mesh)
         return st, m
 
     return sharded_tick
@@ -236,13 +252,9 @@ def sharded_convergence_check(state: MeshState):
 
     Returns ``(converged, fp_min, fp_max, n_alive)``.
     """
-    from kaboodle_tpu.ops.hashing import fingerprint_agreement, membership_fingerprint
+    from kaboodle_tpu.sim.runner import state_agreement
 
-    fp = membership_fingerprint(
-        state.state > 0,
-        state.id_view if state.id_view is not None else state.identity,
-    )
-    return fingerprint_agreement(state.alive, fp)
+    return state_agreement(state)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh", "max_ticks"))

@@ -111,6 +111,25 @@ def fleet_run_stats(metrics: TickMetrics) -> np.ndarray:
     return out
 
 
+def warp_stats(dense_ticks, metrics: TickMetrics | None) -> np.ndarray:
+    """Per-dense-tick table of a warped run, tick column = real tick index.
+
+    ``(dense_ticks, metrics)`` come from
+    :func:`kaboodle_tpu.warp.runner.simulate_warped`: only the densely
+    executed ticks carry metrics (leaped spans are provably converged and
+    quiet, so their rows would be constant). The returned table is
+    :func:`tick_stats`' layout with the ``tick`` column rewritten to the
+    actual tick indices — gaps between consecutive rows are exactly the
+    leaped spans. ``None`` metrics (everything leaped) gives an empty table.
+    """
+    if metrics is None:
+        return np.zeros(0, dtype=tick_stats(
+            TickMetrics(*(np.zeros((0,)),) * 6)).dtype)
+    out = tick_stats(metrics)
+    out["tick"] = np.asarray(dense_ticks)
+    return out
+
+
 def log_run(metrics: TickMetrics, emit=print) -> None:
     """Per-tick one-liners (the RUST_LOG=debug analogue, main.rs:54-58)."""
     for row in tick_stats(metrics):

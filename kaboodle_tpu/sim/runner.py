@@ -35,6 +35,30 @@ def simulate(
     return jax.lax.scan(tick, state, inputs)
 
 
+def state_agreement(state: MeshState):
+    """Fingerprint agreement of ``state`` as-is, without a tick.
+
+    The same reduction the tick kernel folds into its end-of-tick metrics
+    (``fp_count`` + ``fingerprint_agreement``) as a standalone read — the
+    ONE definition shared by :func:`converge_loop`'s entry test, the warp
+    runner's horizon checks, and ``parallel.sharded_convergence_check``
+    (which delegates here, so the predicate cannot drift between the dense
+    and sharded paths). Returns ``(converged, fp_min, fp_max, n_alive)``.
+    """
+    from kaboodle_tpu.ops.hashing import fingerprint_agreement, membership_fingerprint
+
+    fp = membership_fingerprint(
+        state.state > 0,
+        state.id_view if state.id_view is not None else state.identity,
+    )
+    return fingerprint_agreement(state.alive, fp)
+
+
+def state_converged(state: MeshState) -> jax.Array:
+    """bool ``[]``: the agreement flag alone (see :func:`state_agreement`)."""
+    return state_agreement(state)[0]
+
+
 def converge_loop(
     state: MeshState,
     tick,
@@ -46,6 +70,9 @@ def converge_loop(
     entry points (kaboodle_tpu.parallel wraps its mesh-constrained tick around
     this). Returns ``(final_state, ticks_run, converged)``; convergence is
     evaluated on end-of-tick state, matching ``LockstepMesh.converged()``.
+    Fingerprint agreement is also checked at loop entry, so an
+    already-converged mesh reports ``ticks_run == 0`` with its state
+    untouched instead of paying one full tick to rediscover agreement.
     """
     idle = idle_inputs(state.n)
 
@@ -58,7 +85,7 @@ def converge_loop(
         st, m = tick(st, idle)
         return st, i + 1, m.converged
 
-    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), jnp.bool_(False)))
+    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), state_converged(state)))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_ticks"))

@@ -1,0 +1,35 @@
+"""Event-horizon fast-forward: advance quiescent tick spans in one pass.
+
+The first subsystem that changes *how many* kernels run rather than how fast
+each one is: horizon.py statically + on-device identifies spans where nothing
+protocol-relevant can happen, leap.py replays k such ticks as one batched
+program (bit-exact with the dense kernel), runner.py interleaves leaps with
+dense ticks behind the same contracts as sim/runner.py — single-device,
+sharded (GSPMD), and fleet (per-member horizon mask) alike.
+"""
+
+from kaboodle_tpu.warp.horizon import (
+    make_expiry_fn,
+    make_quiescence_fn,
+    next_static_event,
+    static_event_ticks,
+)
+from kaboodle_tpu.warp.leap import make_leap_fn
+from kaboodle_tpu.warp.runner import (
+    fleet_quiescence_mask,
+    run_fleet_warped,
+    run_warped,
+    simulate_warped,
+)
+
+__all__ = [
+    "make_expiry_fn",
+    "make_quiescence_fn",
+    "next_static_event",
+    "static_event_ticks",
+    "make_leap_fn",
+    "fleet_quiescence_mask",
+    "run_fleet_warped",
+    "run_warped",
+    "simulate_warped",
+]

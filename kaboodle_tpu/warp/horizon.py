@@ -1,0 +1,177 @@
+"""Event-horizon computation: how far ahead nothing protocol-relevant happens.
+
+A *warp span* is a run of ticks the leap kernel (warp/leap.py) may replay in
+one batched pass instead of k dense tick dispatches. The horizon is the
+earliest tick at which anything non-quiescent can happen; it has two parts:
+
+1. **Host-static events** — the ``Scenario`` schedule is declarative data, so
+   kill / revive / partition / drop / manual-ping boundaries are known before
+   the run starts. :func:`static_event_ticks` reduces a stacked ``TickInputs``
+   pytree to a bool ``[T]`` "this tick carries an event" mask, and
+   :func:`next_static_event` scans it forward. An all-``True`` ``drop_ok``
+   matrix and an all-equal partition vector are correctly classified as
+   non-events (they gate nothing).
+
+2. **State-borne activity** — :func:`make_quiescence_fn` builds the on-device
+   predicate under which the *dense fault-free tick provably reduces to the
+   leap's update*: no suspicion or ping-ack timer can expire (no cell is in a
+   waiting state, so nothing ever times out — a fresh ping is always acked
+   within its own tick), no membership-changing gossip delivery can occur
+   (fingerprints agree and every alive row's map is exactly the alive set, so
+   marks move no membership and anti-entropy never fires), and no Join
+   rebroadcast is due (nobody is lonely or unannounced). For completeness
+   :func:`earliest_timer_expiry` reduces the waiting cells' deadlines from
+   the timer tensors — when the mesh is NOT quiescent it tells the runner how
+   long the dense stretch must last before a re-check can possibly flip; the
+   sentinel ``INT32_MAX`` means "no timer armed".
+
+The quiescence conditions map onto the issue's three horizon sources: the
+Scenario boundary is (1); the suspicion/ping-ack expiry source degenerates to
+"any waiting cell exists" because inside a span every ping is acked the tick
+it is sent; the membership-changing gossip source degenerates to the
+convergence + full-membership + anti-entropy-idle test, because with those
+holding no delivery can move membership or identity words.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import fingerprint_agreement, membership_fingerprint
+from kaboodle_tpu.sim.state import MeshState, TickInputs
+from kaboodle_tpu.spec import KNOWN
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def static_event_ticks(inputs: TickInputs) -> np.ndarray:
+    """bool ``[T]``: tick carries a scheduled fault/manual event (host-side).
+
+    Computed once per run on the host from the stacked schedule — these are
+    scenario *inputs*, known before any device work (Scenario builds them
+    with NumPy in the first place). A tick is eventful iff its inputs can
+    change delivery or state relative to the idle fault-free tick: any kill
+    or revive, a partition vector that actually splits (non-uniform ids), a
+    positive drop rate, any in-range manual ping, or a ``drop_ok`` matrix
+    that blocks at least one edge.
+    """
+    kill = np.asarray(inputs.kill)
+    revive = np.asarray(inputs.revive)
+    part = np.asarray(inputs.partition)
+    drop_rate = np.asarray(inputs.drop_rate)
+    manual = np.asarray(inputs.manual_target)
+    eventful = (
+        kill.any(axis=-1)
+        | revive.any(axis=-1)
+        | (part != part[:, :1]).any(axis=-1)
+        | (drop_rate > 0)
+        | (manual >= 0).any(axis=-1)
+    )
+    if inputs.drop_ok is not None:
+        eventful |= ~np.asarray(inputs.drop_ok).all(axis=(-2, -1))
+    return eventful
+
+
+def next_static_event(eventful: np.ndarray, t: int) -> int:
+    """Index of the first eventful tick at or after ``t`` (``len`` if none)."""
+    T = eventful.shape[0]
+    hits = np.nonzero(eventful[t:])[0]
+    return int(t + hits[0]) if hits.size else T
+
+
+@functools.lru_cache(maxsize=None)
+def make_quiescence_fn(cfg: SwimConfig):
+    """Jitted ``MeshState -> bool[]``: may the leap replay the next ticks?
+
+    True iff every condition below holds — each one is exactly what makes a
+    dense-kernel phase a provable no-op inside the span (kernel.py round
+    letters in parens):
+
+    - ``n_alive >= 2`` and, with broadcasts enabled, no alive peer still owes
+      its first Join — so A1 never broadcasts (nobody is lonely either, by
+      the full-membership condition below).
+    - no alive row holds a waiting cell — so A2 (escalation/removal) can
+      never fire: new WaitingForPing cells created inside the span are acked
+      within their own tick (fault-free, both endpoints alive).
+    - every alive row's membership is EXACTLY the alive set — so marks move
+      no membership (B/c1/c2 deliver only to known-everywhere peers), no
+      ping ever targets a dead peer (which would strand a waiting cell), and
+      fingerprints cannot move.
+    - identity views (when tracked) already hold the senders' current words
+      at every member cell — so Q1 marks rewrite nothing and row
+      fingerprints stay put.
+    - fingerprints agree over alive rows — with the above, they stay agreed,
+      so no anti-entropy candidate can match (G is idle).
+    - no carried-over KnownPeersRequest from the previous tick can match at
+      its receiver (phase-0 candidates, ``_ae_phase01``): a span-entry state
+      taken right after convergence can still hold one stale request whose
+      recorded fingerprint predates the final agreement; one dense tick
+      clears it.
+    """
+
+    def quiescent(st: MeshState) -> jax.Array:  # graftlint: traced
+        S, alive = st.state, st.alive
+        n = S.shape[-1]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        eye = idx[:, None] == idx[None, :]
+        member = S > 0
+        arow = alive[:, None]
+
+        no_waiting = ~jnp.any(arow & member & (S != KNOWN))
+        full_alive = ~jnp.any(arow & (member != (alive[None, :] | eye)))
+
+        idv = st.id_view
+        fp = membership_fingerprint(member, idv if idv is not None else st.identity)
+        conv, _, _, n_alive = fingerprint_agreement(alive, fp)
+
+        ident_ok = jnp.bool_(True)
+        if idv is not None:
+            ident_ok = ~jnp.any(arow & member & (idv != st.identity[None, :]))
+
+        # Phase-0 anti-entropy: last tick's KPR senders. Receiver p must be
+        # alive (m0's alive[:, None]); the candidate matches when the
+        # recorded fingerprint disagrees with the receiver's and the
+        # receiver's map is not larger (kernel.py _ae_phase01).
+        n_row = jnp.sum(member, axis=-1, dtype=jnp.int32)
+        p = st.kpr_partner
+        pc = jnp.clip(p, 0)
+        kpr_fires = (
+            (p >= 0)
+            & alive[pc]
+            & (st.kpr_fp != fp[pc])
+            & (n_row[pc] <= st.kpr_n)
+        )
+        no_kpr = ~jnp.any(kpr_fires)
+
+        q = no_waiting & full_alive & conv & (n_alive >= 2) & no_kpr & ident_ok
+        if cfg.join_broadcast_enabled:
+            q &= ~jnp.any(alive & st.never_broadcast)
+        return q
+
+    return jax.jit(quiescent)
+
+
+@functools.lru_cache(maxsize=None)
+def make_expiry_fn(cfg: SwimConfig):
+    """Jitted ``MeshState -> int32[]``: earliest waiting-cell deadline.
+
+    The suspicion/ping-ack source of the horizon, reduced from the current
+    timer tensors: ``min`` over alive rows' waiting cells of
+    ``timer + ping_timeout_ticks`` (the tick at which A2 would escalate or
+    remove that entry — kernel.py's ``age >= cfg.ping_timeout_ticks``).
+    ``INT32_MAX`` when no timer is armed. Diagnostic companion to the
+    quiescence predicate: a non-quiescent mesh with an armed timer cannot
+    flip quiescent before its earliest deadline resolves.
+    """
+
+    def expiry(st: MeshState) -> jax.Array:  # graftlint: traced
+        waiting = st.alive[:, None] & (st.state > 0) & (st.state != KNOWN)
+        deadline = st.timer.astype(jnp.int32) + jnp.int32(cfg.ping_timeout_ticks)
+        return jnp.min(jnp.where(waiting, deadline, _I32MAX))
+
+    return jax.jit(expiry)

@@ -1,0 +1,293 @@
+"""Warp runners: interleave event-horizon leaps with dense ticks.
+
+The dense runners (sim/runner.py, parallel/mesh.py, fleet/core.py) dispatch
+one full tick kernel per simulated tick, whatever the tick does. These
+runners split every run into *spans* bounded by the event horizon
+(warp/horizon.py): a span whose entry state is quiescent and whose schedule
+carries no events is replayed by the leap kernel (warp/leap.py) in one
+batched dispatch — bit-exact with dense ticking — and everything else runs
+dense. The contract mirrors the dense entry points:
+
+- :func:`simulate_warped` — the ``simulate`` twin over a stacked schedule;
+  returns the final state plus the metrics of exactly the densely-executed
+  ticks (leaped ticks have provably constant metrics — converged, full
+  agreement, ``2 * n_alive`` unicasts — so nothing is lost).
+- :func:`run_warped` — advance a fault-free mesh exactly ``ticks`` ticks;
+  ``(state, ticks_run, converged)``, the converge-loop contract.
+- Both accept ``mesh=`` to run the sharded twins: dense ticks through
+  ``parallel.make_sharded_tick``, the leap with its scan carries pinned to
+  the same GSPMD row layout (``parallel.row_matrix_sharding`` /
+  ``parallel.constrain_state``).
+- :func:`fleet_quiescence_mask` / :func:`run_fleet_warped` — the ensemble
+  integration: the horizon predicate vmapped over the ``[E]`` axis gives a
+  per-member mask; while EVERY member is quiescent the whole fleet leaps as
+  one vmapped program (each member under its own key chain and timers —
+  independent leaps inside one dispatch). A mixed fleet runs dense for
+  everyone: under ``vmap`` a per-member branch batches to a select that
+  executes both sides, so skipping work for a subset is impossible — the
+  lockstep price of batching already documented in fleet/core.py; dense is
+  bit-identical for the quiescent members, so nothing diverges.
+
+Spans leap in power-of-two chunks (``_span_chunks``): leap composition is
+exact (``leap(a)`` then ``leap(b)`` is bit-equal to ``leap(a + b)`` — the
+key chain and timer carry thread through), so a span of any length costs at
+most ``log2(span)`` cached dispatches while the compiled-program cache stays
+bounded at O(log max_span) entries per config instead of one program per
+distinct span length. Dense single-tick programs are cached per config. The
+host drives span selection (span lengths are data-dependent); every
+decision fetch is one scalar per span, not per tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import state_converged
+from kaboodle_tpu.sim.state import MeshState, TickInputs, idle_inputs
+from kaboodle_tpu.warp.horizon import (
+    make_quiescence_fn,
+    next_static_event,
+    static_event_ticks,
+)
+from kaboodle_tpu.warp.leap import make_leap_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_tick(cfg: SwimConfig, faulty: bool, mesh=None):
+    if mesh is None:
+        return jax.jit(make_tick_fn(cfg, faulty=faulty))
+    from kaboodle_tpu.parallel.mesh import make_sharded_tick
+
+    return jax.jit(make_sharded_tick(cfg, mesh, faulty=faulty))
+
+
+@functools.lru_cache(maxsize=None)
+def _leap(cfg: SwimConfig, k: int, mesh=None):
+    if mesh is None:
+        return jax.jit(make_leap_fn(cfg, k))
+    from kaboodle_tpu.parallel.mesh import constrain_state, row_matrix_sharding
+
+    sharding = row_matrix_sharding(mesh)
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    leap = make_leap_fn(cfg, k, constrain=constrain)
+
+    def sharded_leap(st: MeshState) -> MeshState:
+        return constrain_state(leap(st), mesh)
+
+    return jax.jit(sharded_leap)
+
+
+@functools.lru_cache(maxsize=None)
+def _converged(mesh=None):
+    if mesh is None:
+        return jax.jit(state_converged)
+
+    def check(st: MeshState):
+        from kaboodle_tpu.parallel.mesh import sharded_convergence_check
+
+        return sharded_convergence_check(st)[0]
+
+    return check
+
+
+def _slice_tick(inputs: TickInputs, t: int) -> TickInputs:
+    return jax.tree.map(lambda x: x[t], inputs)
+
+
+def _span_chunks(k: int):
+    """Power-of-two decomposition of a span length, largest chunk first.
+
+    Bounds the leap-program cache (one compiled program per power of two,
+    not per distinct span length) at the cost of <= log2(k) dispatches per
+    span; composition is exact (module docstring)."""
+    while k > 0:
+        p = 1 << (k.bit_length() - 1)
+        yield p
+        k -= p
+
+
+def _leap_span(state, cfg: SwimConfig, k: int, mesh):
+    for chunk in _span_chunks(k):
+        state = _leap(cfg, chunk, mesh)(state)
+    return state
+
+
+def simulate_warped(
+    state: MeshState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    faulty: bool = True,
+    recheck_every: int = 16,
+    mesh=None,
+    on_boundary=None,
+):
+    """Run a stacked ``[T]`` schedule, fast-forwarding quiescent spans.
+
+    The twin of :func:`kaboodle_tpu.sim.runner.simulate`: same state, same
+    schedule, bit-identical final state — but spans with no scheduled event
+    whose entry state passes the quiescence predicate advance through the
+    leap kernel in one dispatch. Non-quiescent event-free stretches (e.g.
+    re-convergence after a fault window) run dense, re-checking the
+    predicate every ``recheck_every`` ticks. ``mesh`` selects the sharded
+    twins for both the dense ticks and the leap.
+
+    Returns ``(final_state, dense_ticks, dense_metrics)``: the int32 ``[M]``
+    indices of the ticks that executed densely and their stacked
+    ``TickMetrics`` (``None`` when every tick leaped). ``on_boundary(t,
+    state)``, when given, is called at each leap's entry and exit boundary
+    with the tick index about to run / just reached — the hook the parity
+    fuzz uses to pin state equality at every event-horizon boundary.
+    """
+    T = int(np.asarray(inputs.kill).shape[0])
+    eventful = static_event_ticks(inputs)
+    tick = _dense_tick(cfg, faulty, mesh)
+    quiescent = make_quiescence_fn(cfg)
+    recheck_every = max(1, int(recheck_every))
+    dense_ticks: list[int] = []
+    metrics = []
+    t = 0
+    while t < T:
+        if not eventful[t]:
+            span_end = next_static_event(eventful, t)
+            if bool(quiescent(state)):
+                if on_boundary is not None:
+                    on_boundary(t, state)
+                state = _leap_span(state, cfg, span_end - t, mesh)
+                t = span_end
+                if on_boundary is not None:
+                    on_boundary(t, state)
+                continue
+            stop = min(span_end, t + recheck_every)
+        else:
+            stop = t + 1
+        while t < stop:
+            state, m = tick(state, _slice_tick(inputs, t))
+            dense_ticks.append(t)
+            metrics.append(m)
+            t += 1
+    stacked = (
+        jax.tree.map(lambda *xs: jnp.stack(xs), *metrics) if metrics else None
+    )
+    return state, np.asarray(dense_ticks, dtype=np.int32), stacked
+
+
+def run_warped(
+    state: MeshState,
+    cfg: SwimConfig,
+    ticks: int,
+    recheck_every: int = 16,
+    mesh=None,
+):
+    """Advance a fault-free mesh exactly ``ticks`` ticks, leaping spans.
+
+    The steady-state-service entry point: a converged idle mesh leaps the
+    whole budget in one dispatch; an unconverged one runs dense (re-checking
+    the horizon every ``recheck_every`` ticks) until quiescence, then leaps
+    the remainder. Returns ``(state, ticks_run, converged)`` — the
+    ``run_until_converged`` contract, with ``ticks_run == ticks`` always
+    (the budget is exact, not a bound) and ``converged`` evaluated on the
+    final state.
+    """
+    tick = _dense_tick(cfg, False, mesh)
+    quiescent = make_quiescence_fn(cfg)
+    idle = idle_inputs(state.n)
+    recheck_every = max(1, int(recheck_every))
+    t = 0
+    while t < ticks:
+        if bool(quiescent(state)):
+            state = _leap_span(state, cfg, ticks - t, mesh)
+            t = ticks
+            break
+        stop = min(ticks, t + recheck_every)
+        while t < stop:
+            state, _ = tick(state, idle)
+            t += 1
+    return state, jnp.int32(t), _converged(mesh)(state)
+
+
+# ---------------------------------------------------------------------------
+# fleet integration
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_quiescent(cfg: SwimConfig):
+    return jax.jit(jax.vmap(make_quiescence_fn(cfg)))
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_converged():
+    return jax.jit(jax.vmap(state_converged))
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_leap(cfg: SwimConfig, k: int):
+    return jax.jit(jax.vmap(make_leap_fn(cfg, k)))
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_tick(cfg: SwimConfig):
+    from kaboodle_tpu.fleet.core import make_fleet_tick_fn
+
+    return jax.jit(make_fleet_tick_fn(cfg, faulty=False))
+
+
+def fleet_quiescence_mask(fleet, cfg: SwimConfig) -> jax.Array:
+    """bool ``[E]``: per-member event horizon — which members could leap now.
+
+    The quiescence predicate vmapped over the ensemble axis; computed
+    on-device, one bool per member (feed it to stats or fetch it once per
+    span — never per tick)."""
+    return _fleet_quiescent(cfg)(fleet.mesh)
+
+
+def run_fleet_warped(
+    fleet,
+    cfg: SwimConfig,
+    ticks: int,
+    recheck_every: int = 16,
+):
+    """Advance every fleet member exactly ``ticks`` fault-free ticks.
+
+    While the per-member horizon mask is all-quiescent the whole ensemble
+    leaps as ONE vmapped program — each member under its own key chain and
+    timers, i.e. E independent leaps in a single dispatch. Any unquiescent
+    member sends the whole fleet dense for ``recheck_every`` ticks (the
+    vmap-lockstep price — see module docstring); dense is bit-identical for
+    the members that could have leaped, so per-member trajectories match
+    standalone :func:`run_warped` runs either way (tests/test_warp.py).
+
+    Fault-free only (the leap's precondition): the per-member ``drop_rate``
+    knob is inert here, exactly as in ``run_fleet_until_converged``'s
+    default mode. Returns ``(fleet, ticks_run, converged)`` with
+    ``converged`` a per-member ``[E]`` bool of the final states.
+    """
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs
+
+    mesh_state = fleet.mesh
+    idle = fleet_idle_inputs(fleet.n, fleet.ensemble)
+    tick = _fleet_tick(cfg)
+    recheck_every = max(1, int(recheck_every))
+    t = 0
+    while t < ticks:
+        mask = np.asarray(_fleet_quiescent(cfg)(mesh_state))
+        if mask.all():
+            for chunk in _span_chunks(ticks - t):
+                mesh_state = _fleet_leap(cfg, chunk)(mesh_state)
+            t = ticks
+            break
+        stop = min(ticks, t + recheck_every)
+        while t < stop:
+            mesh_state, _ = tick(mesh_state, idle)
+            t += 1
+    converged = _fleet_converged()(mesh_state)
+    return dataclasses.replace(fleet, mesh=mesh_state), jnp.int32(t), converged

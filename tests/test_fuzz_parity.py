@@ -73,6 +73,101 @@ def test_random_scenario_random_flags(seed):
     _run_parity(mesh, st, _random_inputs(rng, n, TICKS), cfg=cfg)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_random_sparse_schedule_warp_arm(seed):
+    """The warp runner as a fuzz arm: random SPARSE fault schedules (so
+    quiescent spans exist for the leap to take) x random protocol flags,
+    from a converged init — the warped run must equal the dense tick-by-tick
+    trajectory at every event-horizon boundary and at termination, and the
+    densely-executed ticks' metrics must equal the dense scan's rows."""
+    import jax
+
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs
+    from kaboodle_tpu.warp.runner import simulate_warped
+
+    rng = np.random.default_rng(3000 + seed)
+    n = int(rng.integers(10, 24))
+    ticks = int(rng.integers(24, 48))
+    # Random flags (deterministic not required: both arms run the same
+    # kernel program, so random draws agree by the shared counter-based
+    # key chain). Warp requires no flag in particular — a config that never
+    # quiesces just runs dense, still bit-exact.
+    cfg = SwimConfig(
+        deterministic=bool(rng.integers(2)),
+        backdate_gossip_inserts=bool(rng.integers(2)),
+        faithful_indirect_ack=bool(rng.integers(2)),
+        max_share_peers=int(rng.choice([0, 6, 300])),
+    )
+    timer_dtype = jnp.int16 if rng.integers(2) else jnp.int32
+    lean = bool(rng.integers(2))
+    st = init_state(n, seed=seed, ring_contacts=n - 1, announced=True,
+                    track_latency=not lean, instant_identity=lean,
+                    timer_dtype=timer_dtype)
+
+    # Sparse events: a few isolated ticks carry faults, the rest are idle.
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    revive = np.zeros((ticks, n), dtype=bool)
+    manual = np.full((ticks, n), -1, dtype=np.int32)
+    drop_ok = np.ones((ticks, n, n), dtype=bool)
+    for t in sorted(rng.choice(ticks, size=3, replace=False)):
+        kind = rng.integers(4)
+        if kind == 0:
+            kill[t, rng.integers(n)] = True
+        elif kind == 1:
+            dead = ~((~kill[:t + 1]).all(axis=0))
+            if dead.any():
+                revive[t, np.nonzero(dead)[0][0]] = True
+            else:
+                manual[t, 0] = int(rng.integers(1, n))
+        elif kind == 2:
+            manual[t, rng.integers(n)] = int(rng.integers(n))
+        else:
+            drop_ok[t] = rng.random((n, n)) >= 0.15
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=jnp.asarray(revive),
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=jnp.asarray(manual),
+        drop_ok=jnp.asarray(drop_ok),
+    )
+
+    # Dense arm, tick by tick (states banked for the boundary comparison).
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
+    sd = st
+    dense_states, dense_metrics = [], []
+    for t in range(ticks):
+        sd, m = tick_fn(sd, jax.tree.map(lambda x: x[t], inputs))
+        dense_states.append(sd)
+        dense_metrics.append(m)
+
+    boundaries = []
+    wf, dense_ticks, wm = simulate_warped(
+        st, inputs, cfg, faulty=True, recheck_every=3,
+        on_boundary=lambda t, s: boundaries.append((t, s)),
+    )
+
+    def assert_equal(a, b, ctx):
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            xv, yv = np.asarray(x), np.asarray(y)
+            if xv.dtype == np.float32:
+                ok = ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+            else:
+                ok = (xv == yv).all()
+            assert ok, f"warp mismatch {ctx} (seed {seed})"
+
+    assert_equal(sd, wf, "at termination")
+    for t, s in boundaries:
+        assert_equal(st if t == 0 else dense_states[t - 1], s, f"boundary {t}")
+    for j, t in enumerate(dense_ticks):
+        assert_equal(
+            dense_metrics[t], jax.tree.map(lambda x: x[j], wm),
+            f"metrics at tick {t}",
+        )
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_random_scenario_chunked_third_engine(seed):
     """The chunked (row-blocked) kernel as a third arm of the same fuzz:

@@ -1,0 +1,344 @@
+"""warp — event-horizon fast-forward: bit-exactness + contracts.
+
+The leap kernel's whole value is that it is NOT a new simulator: a warped
+run must be indistinguishable, bit for bit, from dense tick-by-tick
+execution on every parity config the repo already pins — full state,
+lean+int16, sharded (GSPMD), and fleet members — plus the runner contracts
+(exact tick budgets, boundary metrics, the converge-loop entry check this
+PR's satellite adds). The randomized whole-schedule arm lives in
+tests/test_fuzz_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.runner import run_until_converged, simulate
+from kaboodle_tpu.sim.scenario import Scenario
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+from kaboodle_tpu.warp.horizon import (
+    make_expiry_fn,
+    make_quiescence_fn,
+    next_static_event,
+    static_event_ticks,
+)
+from kaboodle_tpu.warp.leap import make_leap_fn
+from kaboodle_tpu.warp.runner import (
+    fleet_quiescence_mask,
+    run_fleet_warped,
+    run_warped,
+    simulate_warped,
+)
+
+
+def _assert_leaves_equal(tree_a, tree_b, ctx=""):
+    for a, b in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)):
+        av, bv = np.asarray(a), np.asarray(b)
+        if av.dtype == np.float32:  # latency plane carries NaNs (no sample)
+            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all(), ctx
+        else:
+            assert (av == bv).all(), (ctx, av.dtype)
+
+
+def _dense_trajectory(st, cfg, ticks, faulty=False, inputs=None):
+    tick = jax.jit(make_tick_fn(cfg, faulty=faulty))
+    idle = idle_inputs(st.n)
+    states = []
+    for t in range(ticks):
+        inp = idle if inputs is None else jax.tree.map(lambda x: x[t], inputs)
+        st, m = tick(st, inp)
+        states.append(st)
+    return st, states
+
+
+def _converged_init(n, seed=0, **kw):
+    return init_state(n, seed=seed, ring_contacts=n - 1, announced=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# leap vs dense, per parity config
+
+
+@pytest.mark.parametrize("det", [True, False])
+def test_leap_matches_dense_full_state(det):
+    """Full state (latency EWMA + identity views), int32 timers: a 9-tick
+    leap equals 9 dense fault-free ticks on every leaf."""
+    n, k = 32, 9
+    cfg = SwimConfig(deterministic=det)
+    st = _converged_init(n, seed=3)
+    dense, _ = _dense_trajectory(st, cfg, k)
+    _assert_leaves_equal(dense, jax.jit(make_leap_fn(cfg, k))(st), f"det={det}")
+
+
+def test_leap_matches_dense_lean_int16():
+    """The bench state variant (no latency, instant identity, int16 timers)
+    with a span long enough that refreshed entries re-enter the oldest-5
+    rotation (k > n)."""
+    n, k = 48, 70
+    cfg = SwimConfig()
+    st = _converged_init(n, seed=5, track_latency=False, instant_identity=True,
+                         timer_dtype=jnp.int16)
+    dense, _ = _dense_trajectory(st, cfg, k)
+    _assert_leaves_equal(dense, jax.jit(make_leap_fn(cfg, k))(st), "lean+int16")
+
+
+def test_leap_matches_dense_with_dead_rows():
+    """Quiescent steady state AFTER churn: a fully-purged dead peer (absent
+    from every survivor's map) leaves frozen dead rows the leap must carry
+    untouched while survivors keep pinging."""
+    n = 24
+    cfg = SwimConfig()
+    st = _converged_init(n, seed=1)
+    # Kill one peer, then give the survivors the full purge window (the
+    # detection-completeness bound is ~2N ticks; right after the kill the
+    # mesh is only TRANSIENTLY converged — everyone still agrees on the
+    # not-yet-purged dead peer).
+    inp = Scenario(n, 1, seed=0).kill_at(0, [n // 2]).build()
+    tick = jax.jit(make_tick_fn(cfg, faulty=True))
+    st, _m = tick(st, jax.tree.map(lambda x: x[0], inp))
+    st, _ = _dense_trajectory(st, cfg, 4 * n)
+    alive = np.asarray(st.alive)
+    assert not (np.asarray(st.state)[alive][:, n // 2] > 0).any(), "not purged"
+    # The purged steady state may still owe a dense tick or two (stale
+    # anti-entropy ledger); run_warped handles that, then leaps.
+    k = 12
+    dense, _ = _dense_trajectory(st, cfg, k)
+    warped, ticks_run, wconv = run_warped(st, cfg, k, recheck_every=2)
+    assert int(ticks_run) == k and bool(wconv)
+    _assert_leaves_equal(dense, warped, "dead rows")
+    assert not bool(np.asarray(warped.alive)[n // 2])
+
+
+def test_run_warped_from_unconverged_matches_dense():
+    """An unconverged boot runs dense until quiescence then leaps; the whole
+    budget must still be bit-exact with pure dense ticking."""
+    n, ticks = 32, 24
+    cfg = SwimConfig()
+    st = init_state(n, seed=2, ring_contacts=2)
+    dense, _ = _dense_trajectory(st, cfg, ticks)
+    warped, ticks_run, conv = run_warped(st, cfg, ticks, recheck_every=4)
+    assert int(ticks_run) == ticks
+    _assert_leaves_equal(dense, warped, "unconverged entry")
+
+
+# ---------------------------------------------------------------------------
+# scenario runner: boundaries + metrics
+
+
+def test_simulate_warped_scenario_boundaries_and_metrics():
+    """Sparse-fault schedule (manual pings + a kill): the warped run equals
+    dense at every event-horizon boundary and at termination, and the
+    densely-executed ticks' metrics equal the dense scan's rows."""
+    n, T = 24, 48
+    cfg = SwimConfig()
+    sc = (Scenario(n, T, seed=0)
+          .manual_ping_at(8, 0, 2)
+          .kill_at(16, [3])
+          .manual_ping_at(40, 1, 5))
+    st = _converged_init(n, seed=1)
+    inp = sc.build()
+
+    dense_final, dense_m = jax.jit(
+        lambda s, i: simulate(s, i, cfg, faulty=True)
+    )(st, inp)
+    _, dense_states = _dense_trajectory(st, cfg, T, faulty=True, inputs=inp)
+
+    boundaries = []
+    warped, dense_ticks, warped_m = simulate_warped(
+        st, inp, cfg, faulty=True, recheck_every=4,
+        on_boundary=lambda t, s: boundaries.append((t, s)),
+    )
+    _assert_leaves_equal(dense_final, warped, "final state")
+    assert len(boundaries) >= 2  # at least one leap happened
+    for t, s in boundaries:
+        if t == 0:
+            _assert_leaves_equal(st, s, "boundary 0")
+        else:
+            _assert_leaves_equal(dense_states[t - 1], s, f"boundary {t}")
+    # Metrics of every densely executed tick match the dense scan's rows.
+    for j, t in enumerate(dense_ticks):
+        _assert_leaves_equal(
+            jax.tree.map(lambda x: x[t], dense_m),
+            jax.tree.map(lambda x: x[j], warped_m),
+            f"metrics at tick {t}",
+        )
+    # The scheduled events themselves always run dense.
+    assert {8, 16, 40} <= set(int(t) for t in dense_ticks)
+
+
+def test_simulate_warped_all_quiescent_no_dense_ticks():
+    """A fault-free schedule from a converged init leaps end to end: zero
+    dense ticks, empty metrics, exact final state."""
+    n, T = 24, 32
+    cfg = SwimConfig()
+    st = _converged_init(n, seed=4)
+    inp = Scenario(n, T, seed=0).build()
+    dense_final, _ = jax.jit(lambda s, i: simulate(s, i, cfg, faulty=True))(st, inp)
+    warped, dense_ticks, metrics = simulate_warped(st, inp, cfg, faulty=True)
+    assert dense_ticks.size == 0 and metrics is None
+    _assert_leaves_equal(dense_final, warped, "all-leap")
+
+
+# ---------------------------------------------------------------------------
+# horizon pieces
+
+
+def test_static_event_ticks_classification():
+    n, T = 8, 12
+    sc = (Scenario(n, T, seed=0)
+          .kill_at(2, [1]).revive_at(5, [1])
+          .drop(0.1, start=7, stop=8)
+          .manual_ping_at(9, 0, 3))
+    ev = static_event_ticks(sc.build())
+    assert list(np.nonzero(ev)[0]) == [2, 5, 7, 9]
+    assert next_static_event(ev, 0) == 2
+    assert next_static_event(ev, 3) == 5
+    assert next_static_event(ev, 10) == T
+    # All-True drop_ok and a uniform nonzero partition gate nothing.
+    idle = idle_inputs(n, ticks=T)
+    quiet = dataclasses.replace(
+        idle,
+        drop_ok=jnp.ones((T, n, n), dtype=bool),
+        partition=jnp.full((T, n), 3, dtype=jnp.int32),
+    )
+    assert not static_event_ticks(quiet).any()
+
+
+def test_quiescence_predicate():
+    n = 16
+    cfg = SwimConfig()
+    q = make_quiescence_fn(cfg)
+    assert bool(q(_converged_init(n)))
+    # Unconverged boot: not quiescent.
+    assert not bool(q(init_state(n, seed=0, ring_contacts=2)))
+    # A waiting cell arms a suspicion timer: not quiescent, expiry reported.
+    st = _converged_init(n)
+    state = np.asarray(st.state).copy()
+    timer = np.asarray(st.timer).copy()
+    state[0, 1] = 2  # WAITING_FOR_PING
+    timer[0, 1] = 0
+    st_w = dataclasses.replace(
+        st, state=jnp.asarray(state), timer=jnp.asarray(timer)
+    )
+    assert not bool(q(st_w))
+    assert int(make_expiry_fn(cfg)(st_w)) == cfg.ping_timeout_ticks
+    assert int(make_expiry_fn(cfg)(st)) == np.iinfo(np.int32).max
+
+
+# ---------------------------------------------------------------------------
+# sharded + fleet integration
+
+
+def test_run_warped_sharded_matches_dense():
+    """The leap under GSPMD (row-sharded scan carries, cross-shard scatter)
+    equals the sharded dense trajectory and stays sharded."""
+    from kaboodle_tpu.parallel import make_mesh, make_sharded_tick, shard_state
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    n, ticks = 64, 16
+    mesh = make_mesh(8)
+    cfg = SwimConfig()
+    st = shard_state(_converged_init(n, seed=5), mesh)
+    stick = jax.jit(make_sharded_tick(cfg, mesh, faulty=False))
+    idle = idle_inputs(n)
+    dense = st
+    for _ in range(ticks):
+        dense, _m = stick(dense, idle)
+    warped, ticks_run, conv = run_warped(st, cfg, ticks, mesh=mesh)
+    assert int(ticks_run) == ticks and bool(conv)
+    _assert_leaves_equal(dense, warped, "sharded")
+    assert len(warped.state.sharding.device_set) == 8
+
+
+def test_fleet_warp_per_member_mask_and_parity():
+    """A mixed fleet (one converged member, one mid-boot) reports a mixed
+    horizon mask, and every member's warped trajectory is bit-exact with its
+    standalone run — whether it leaped or rode the dense lockstep."""
+    from kaboodle_tpu.fleet.core import FleetState, member_state
+
+    n, ticks = 16, 12
+    cfg = SwimConfig()
+    members = [_converged_init(n, seed=0), init_state(n, seed=1, ring_contacts=2)]
+    mesh_state = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *members)
+    fleet = FleetState(mesh=mesh_state, drop_rate=jnp.zeros((2,), jnp.float32))
+    mask = np.asarray(fleet_quiescence_mask(fleet, cfg))
+    assert mask.tolist() == [True, False]
+
+    out, ticks_run, conv = run_fleet_warped(fleet, cfg, ticks, recheck_every=4)
+    assert int(ticks_run) == ticks
+    for e in range(2):
+        ref, _ = _dense_trajectory(members[e], cfg, ticks)
+        _assert_leaves_equal(ref, member_state(out, e), f"member {e}")
+    assert bool(np.asarray(conv).all())
+
+
+def test_fleet_warp_all_quiescent_leaps():
+    """An all-converged fleet leaps as one vmapped program; member k equals
+    the standalone warped (== dense) run."""
+    from kaboodle_tpu.fleet.core import init_fleet, member_state
+
+    n, e, ticks = 16, 4, 10
+    cfg = SwimConfig()
+    fleet = init_fleet(n, e, ring_contacts=n - 1, announced=True)
+    assert np.asarray(fleet_quiescence_mask(fleet, cfg)).all()
+    out, ticks_run, conv = run_fleet_warped(fleet, cfg, ticks)
+    assert int(ticks_run) == ticks and bool(np.asarray(conv).all())
+    for k in range(e):
+        ref, _, _ = run_warped(member_state(fleet, k), cfg, ticks)
+        _assert_leaves_equal(ref, member_state(out, k), f"member {k}")
+
+
+# ---------------------------------------------------------------------------
+# satellite: converge-loop entry check
+
+
+def test_converge_loop_entry_converged_runs_zero_ticks():
+    """An already-converged mesh reports ticks_run == 0 with its state
+    untouched (the satellite regression: it used to always execute one
+    tick)."""
+    n = 24
+    cfg = SwimConfig()
+    st = _converged_init(n, seed=0)
+    out, ticks, conv = run_until_converged(st, cfg, max_ticks=16)
+    assert int(ticks) == 0 and bool(conv)
+    _assert_leaves_equal(st, out, "entry state")
+
+
+def test_converge_loop_entry_check_sharded():
+    from kaboodle_tpu.parallel import make_mesh, run_until_converged_sharded, shard_state
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    n = 32
+    mesh = make_mesh(8)
+    cfg = SwimConfig()
+    st = shard_state(_converged_init(n, seed=0), mesh)
+    out, ticks, conv = run_until_converged_sharded(st, cfg, mesh, max_ticks=16)
+    assert int(ticks) == 0 and bool(conv)
+    _assert_leaves_equal(st, out, "sharded entry state")
+
+
+def test_fleet_converge_loop_entry_converged_member():
+    """A fleet whose members are all converged at entry freezes immediately:
+    conv_tick all zero, states untouched — matching the standalone loop."""
+    from kaboodle_tpu.fleet.core import init_fleet, member_state, run_fleet_until_converged
+
+    n, e = 16, 3
+    cfg = SwimConfig()
+    fleet = init_fleet(n, e, ring_contacts=n - 1, announced=True)
+    out, conv_tick, done = run_fleet_until_converged(fleet, cfg, max_ticks=8)
+    assert np.asarray(done).all()
+    assert np.asarray(conv_tick).tolist() == [0] * e
+    for k in range(e):
+        _assert_leaves_equal(
+            member_state(fleet, k), member_state(out, k), f"member {k}"
+        )

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -12,12 +12,20 @@ test:
 test-quick:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-# graftlint: the dependency-free JAX/TPU-aware AST gate — the clippy
-# `-D warnings` analogue (reference main.yml:48-52). Rules KB1xx/KB2xx/KB3xx;
-# `--no-baseline-growth` makes the checked-in baseline monotonically
+# graftlint + graftscan: the two-lane static gate. Line 1 is the
+# dependency-free JAX/TPU-aware AST lane — the clippy `-D warnings`
+# analogue (reference main.yml:48-52), rules KB1xx/KB2xx/KB3xx, parse
+# speed. Line 2 is the IR lane (kaboodle_tpu/analysis/ir/): rules
+# KB401-KB405 over the TRACED kernel entry points — dtype widening under
+# x64, host callbacks, baked-in constants, GSPMD spec derivation, and the
+# compile-surface budget (.graftscan_surface.json) measured by a scripted
+# dense+warp+fleet exercise (~1 min on CPU, the only compile-heavy step).
+# `--no-baseline-growth` makes BOTH checked-in baselines monotonically
 # shrinking debt. See kaboodle_tpu/analysis/ (scripts/lint.py is a shim).
 lint:
 	$(PYTHON) -m kaboodle_tpu.analysis --no-baseline-growth
+	timeout 300 env JAX_PLATFORMS=cpu \
+	  $(PYTHON) -m kaboodle_tpu.analysis --ir --no-baseline-growth
 	$(PYTHON) scripts/license_check.py
 
 native:
@@ -66,6 +74,14 @@ fleet-dryrun:
 # (PERF.md "Warp"); CI only proves the lane runs end-to-end.
 warp-dryrun:
 	timeout 300 $(PYTHON) bench.py --warp --platform cpu --n 256 --ticks 64
+
+# graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
+# entry-point registry, run KB401-405, compare the compile surface against
+# the committed budget — in its own timed process. The same invocation
+# `make lint` runs; this target exists for iterating on the scan itself.
+scan-dryrun:
+	timeout 300 env JAX_PLATFORMS=cpu \
+	  $(PYTHON) -m kaboodle_tpu.analysis --ir --no-baseline-growth
 
 # Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
 # then the every-fault-path scan, N=8192 over 8 virtual CPU devices,

@@ -10,20 +10,28 @@ repo's jit/shard_map idioms. Rule families:
   branching on traced values (KB201), host coercions of tracers (KB202),
   ``print`` in jit (KB203), PRNG key reuse (KB204), use-after-donation
   (KB205).
-- **KB3xx hot-path** (``kaboodle_tpu/sim/`` + ``kaboodle_tpu/ops/``):
-  host syncs in the tick kernels (KB301), dtype-less ``jnp`` constructors
-  in the dtype-disciplined files (KB302).
+- **KB3xx hot-path** (``sim/``, ``ops/``, ``fleet/``, ``warp/``,
+  ``oracle/``): host syncs in the tick kernels (KB301), dtype-less ``jnp``
+  constructors in the dtype-disciplined files (KB302).
+- **KB4xx IR** (graftscan, ``analysis/ir/`` — the ``--ir`` lane): passes
+  over the *traced* kernel entry points — dtype widening under x64
+  (KB401), host callbacks in jitted programs (KB402), oversized captured
+  constants (KB403), GSPMD spec derivation (KB404), and the
+  compile-surface budget vs ``.graftscan_surface.json`` (KB405).
 
 Suppression: per-line ``# noqa: KBnnn`` (bare ``# noqa`` and foreign-code
 lists suppress everything on the line), or a justified entry in the
-checked-in baseline ``.graftlint_baseline.json`` — see ``core.py``.
+checked-in baseline — ``.graftlint_baseline.json`` for the AST lane,
+``.graftscan_baseline.json`` for IR findings (which have no source line to
+noqa) — see ``core.py``.
 
-CLI: ``python -m kaboodle_tpu.analysis [--explain KBnnn] [paths...]``;
-``make lint`` and CI run it with the default target set, and CI's
-``--no-baseline-growth`` step guarantees the baseline only shrinks.
+CLI: ``python -m kaboodle_tpu.analysis [--ir] [--explain KBnnn]
+[paths...]``; ``make lint`` and CI run both lanes, and CI's
+``--no-baseline-growth`` steps guarantee every baseline only shrinks.
 
-This module imports no jax: analysis is pure AST, so the lint lane and its
-tests run at parse speed with no accelerator backend.
+The default lane imports no jax: analysis is pure AST, so it and its tests
+run at parse speed with no accelerator backend. Only the ``--ir`` lane
+(and ``analysis/ir/``'s internals) import jax, CPU-pinned.
 """
 
 from kaboodle_tpu.analysis.core import (

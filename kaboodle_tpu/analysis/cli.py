@@ -1,19 +1,33 @@
-"""graftlint CLI: ``python -m kaboodle_tpu.analysis [options] [paths...]``.
+"""graftlint/graftscan CLI: ``python -m kaboodle_tpu.analysis [options]``.
 
 Exit codes: 0 clean (baselined findings allowed), 1 findings / baseline
 violations, 2 usage or baseline-format error.
 
-Modes:
+Two lanes share one UX:
 
-- default: report every finding whose key is not in the baseline.
+- **AST lane** (default): rules KB1xx-KB3xx over the source tree. Pure
+  ``ast`` + stdlib — no jax, parse speed.
+- **IR lane** (``--ir``): rules KB401-KB405 over the *traced* kernel entry
+  points (kaboodle_tpu/analysis/ir/) plus the compile-surface budget.
+  Imports jax (CPU-pinned), so it is its own invocation — ``make lint``
+  runs both lines.
+
+Modes (both lanes):
+
+- default: report every finding whose key is not in the lane's baseline
+  (``.graftlint_baseline.json`` / ``.graftscan_baseline.json``).
 - ``--no-baseline-growth``: additionally fail on *stale* baseline entries
-  (keys that no longer match any finding). Together with the default mode
-  this makes the baseline monotonically shrinking: new findings can't land
-  (they fail the lint), and fixed findings force their entry's deletion.
-- ``--write-baseline``: regenerate the baseline from current findings,
-  preserving existing reasons; new entries get a TODO reason that the
-  loader will keep accepting but a reviewer should replace.
-- ``--explain KBnnn`` / ``--list-rules``: rule documentation.
+  (keys that no longer match any finding) and, in the IR lane, on a
+  compile-surface count below its committed budget. Together with the
+  default mode this makes both baselines monotonically shrinking.
+- ``--write-baseline``: regenerate the lane's baseline, preserving
+  reasons; ``--write-surface`` (IR) regenerates the surface budget.
+- ``--explain KBnnn`` / ``--list-rules``: rule documentation (all
+  families, either lane — the registry is shared).
+
+IR-lane extras: ``--entries a,b`` scans only the named entry points;
+``--no-surface`` skips the (compile-heavy) KB405 exercise — for fast local
+iteration only, the gate always runs it.
 """
 
 from __future__ import annotations
@@ -27,26 +41,40 @@ DEFAULT_TARGETS = [
     "kaboodle_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py",
 ]
 
+DEFAULT_IR_BASELINE = ".graftscan_baseline.json"
+
 USAGE = """\
 usage: python -m kaboodle_tpu.analysis [options] [paths...]
 
 options:
-  --baseline PATH        baseline file (default: .graftlint_baseline.json)
+  --baseline PATH        baseline file (default: .graftlint_baseline.json,
+                         or .graftscan_baseline.json with --ir)
   --no-baseline          ignore the baseline entirely
   --no-baseline-growth   also fail on stale baseline entries (CI debt gate)
   --write-baseline       regenerate the baseline from current findings
   --explain KBnnn        print one rule's documentation and exit
   --list-rules           print every rule id + title and exit
+  --ir                   run the IR lane (graftscan, KB4xx) instead of the
+                         AST lane; traces the kernel entry-point registry
+  --entries a,b          (--ir) scan only the named entry points
+  --surface PATH         (--ir) surface budget (default: .graftscan_surface.json)
+  --write-surface        (--ir) regenerate the surface budget file
+  --no-surface           (--ir) skip the compile-surface exercise (KB405)
   -h, --help             this message
 """
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    baseline_path = pathlib.Path(core.DEFAULT_BASELINE)
+    baseline_path: pathlib.Path | None = None
     use_baseline = True
     no_growth = False
     write = False
+    ir_mode = False
+    entries_filter: list[str] | None = None
+    surface_path: pathlib.Path | None = None
+    write_surface = False
+    with_surface = True
     targets: list[str] = []
 
     core._load_rules()
@@ -68,6 +96,24 @@ def main(argv: list[str] | None = None) -> int:
             no_growth = True
         elif a == "--write-baseline":
             write = True
+        elif a == "--ir":
+            ir_mode = True
+        elif a == "--entries":
+            i += 1
+            if i >= len(argv):
+                print("--entries needs a comma-separated list", file=sys.stderr)
+                return 2
+            entries_filter = [e for e in argv[i].split(",") if e]
+        elif a == "--surface":
+            i += 1
+            if i >= len(argv):
+                print("--surface needs a path", file=sys.stderr)
+                return 2
+            surface_path = pathlib.Path(argv[i])
+        elif a == "--write-surface":
+            write_surface = True
+        elif a == "--no-surface":
+            with_surface = False
         elif a == "--list-rules":
             for rid in sorted(core.REGISTRY):
                 print(f"{rid}  {core.REGISTRY[rid].title}")
@@ -88,11 +134,32 @@ def main(argv: list[str] | None = None) -> int:
             targets.append(a)
         i += 1
 
+    if ir_mode:
+        if targets:
+            print(
+                "--ir scans the entry-point registry, not paths; use "
+                "--entries name,... to scope it",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_ir(
+            baseline_path or pathlib.Path(DEFAULT_IR_BASELINE),
+            use_baseline,
+            no_growth,
+            write,
+            entries_filter,
+            surface_path,
+            write_surface,
+            with_surface,
+        )
+
     files = core.iter_python_files(targets or DEFAULT_TARGETS)
     findings: list[core.Finding] = []
     for f in files:
         findings.extend(core.analyze_path(f))
 
+    if baseline_path is None:
+        baseline_path = pathlib.Path(core.DEFAULT_BASELINE)
     try:
         baseline = core.load_baseline(baseline_path) if use_baseline else {}
     except core.BaselineError as e:
@@ -125,6 +192,101 @@ def main(argv: list[str] | None = None) -> int:
     print(
         f"graftlint: {len(files)} files, {len(active)} findings"
         + (f" ({suppressed} baselined)" if suppressed else ""),
+        file=sys.stderr,
+    )
+    return rc
+
+
+def _run_ir(
+    baseline_path: pathlib.Path,
+    use_baseline: bool,
+    no_growth: bool,
+    write_baseline: bool,
+    entries_filter: list[str] | None,
+    surface_path: pathlib.Path | None,
+    write_surface: bool,
+    with_surface: bool,
+) -> int:
+    """The --ir lane: trace, audit, gate — same baseline semantics as AST."""
+    from kaboodle_tpu.analysis.ir import scan as ir_scan
+    from kaboodle_tpu.analysis.ir import surface as ir_surface
+
+    if surface_path is None:
+        surface_path = pathlib.Path(ir_surface.DEFAULT_SURFACE)
+
+    # Validate both committed files BEFORE the (trace + compile)-heavy scan,
+    # so a malformed baseline fails in milliseconds, not after a minute.
+    try:
+        baseline = core.load_baseline(baseline_path) if use_baseline else {}
+        committed = ir_surface.load_surface(surface_path)
+    except core.BaselineError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    # --write-baseline alone never reads the surface measurement; skip the
+    # compile-heavy exercise for it (traces are all it needs).
+    measure = (with_surface and not write_baseline) or write_surface
+    try:
+        result = ir_scan.run_scan(
+            entry_names=entries_filter,
+            with_surface=measure,
+            progress=lambda msg: print(msg, file=sys.stderr),
+        )
+    except KeyError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if write_baseline:
+        core.write_baseline(baseline_path, result.findings, baseline)
+        print(
+            f"graftscan: wrote {baseline_path} with "
+            f"{len({x.key for x in result.findings})} entries",
+            file=sys.stderr,
+        )
+    if write_surface:
+        ir_surface.write_surface(surface_path, result.surface_measured, committed)
+        print(f"graftscan: wrote {surface_path}", file=sys.stderr)
+    if write_baseline or write_surface:
+        return 0
+
+    findings = list(result.findings)
+    if with_surface:
+        findings.extend(
+            ir_surface.surface_findings(
+                result.surface_measured, committed, no_growth=no_growth
+            )
+        )
+
+    # KB405 findings are NOT baselineable: the surface budget file (with its
+    # per-entry justifications) is the ONLY accepted record of the compile
+    # surface — a baseline entry keyed 'surface:*:growth' would bypass the
+    # gate for growth of any magnitude, forever.
+    active = [
+        f for f in findings if f.rule == "KB405" or f.key not in baseline
+    ]
+    suppressed = len(findings) - len(active)
+    for f in active:
+        print(f.render())
+
+    rc = 1 if active else 0
+    if no_growth:
+        live_keys = {f.key for f in findings if f.rule != "KB405"}
+        stale = sorted(k for k in baseline if k not in live_keys)
+        for k in stale:
+            print(f"stale baseline entry (fixed? delete it): {k}")
+        if stale:
+            rc = 1
+
+    surf = (
+        "; surface " + ", ".join(
+            f"{k}={v}" for k, v in sorted(result.surface_measured.items())
+        )
+        if result.surface_measured
+        else ""
+    )
+    print(
+        f"graftscan: {result.entries_scanned} entry points, {len(active)} "
+        f"findings" + (f" ({suppressed} baselined)" if suppressed else "") + surf,
         file=sys.stderr,
     )
     return rc

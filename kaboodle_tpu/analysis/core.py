@@ -211,8 +211,16 @@ def iter_python_files(targets: list[str]) -> list[pathlib.Path]:
 
 
 def _load_rules() -> None:
-    """Import the rule modules (idempotent) so REGISTRY is populated."""
-    from kaboodle_tpu.analysis import rules_generic, rules_hotpath, rules_jax  # noqa: F401
+    """Import the rule modules (idempotent) so REGISTRY is populated.
+
+    rules_ir registers only the KB4xx documentation (no-op AST checks);
+    the passes themselves live in analysis/ir/ behind the --ir lane."""
+    from kaboodle_tpu.analysis import (  # noqa: F401
+        rules_generic,
+        rules_hotpath,
+        rules_ir,
+        rules_jax,
+    )
 
 
 # ---------------------------------------------------------------------------

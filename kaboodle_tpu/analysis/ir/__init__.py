@@ -1,0 +1,36 @@
+"""graftscan — the jaxpr/IR-level kernel auditor (rules KB401-KB405).
+
+graftlint (the KB1xx-KB3xx AST lane) guards the source text; this package
+audits the **traced programs**: every registered kernel entry point
+(``registry.py`` — dense/chunked tick, warp leap, fleet tick, fused ops,
+sharded twins) is traced to a ``ClosedJaxpr`` and swept by the pass
+pipeline (``passes.py``):
+
+- **KB401** dtype widening: f64 anywhere (traced under ``enable_x64`` so
+  implicit defaults become visible), int16 lean-state promotions outside
+  the age-arithmetic allowlist;
+- **KB402** host boundaries (callbacks/infeed) inside jitted kernels;
+- **KB403** oversized closure constants baked into programs;
+- **KB404** GSPMD sharding constraints not derived from
+  ``parallel.state_specs``;
+- **KB405** the compile-surface budget (``surface.py``): fresh XLA
+  compilations across a scripted dense+warp+fleet exercise vs the
+  committed ``.graftscan_surface.json``, shrink-only like the lint
+  baseline.
+
+CLI: ``python -m kaboodle_tpu.analysis --ir [--no-baseline-growth]``, with
+``--explain KB4nn`` served by the shared rule registry (rules_ir.py). This
+package imports jax; the parent package's default AST lane does not.
+"""
+
+from kaboodle_tpu.analysis.ir.registry import ENTRY_POINTS, EntryPoint, trace_entry
+from kaboodle_tpu.analysis.ir.scan import ScanResult, run_scan, scan_entry
+
+__all__ = [
+    "ENTRY_POINTS",
+    "EntryPoint",
+    "ScanResult",
+    "run_scan",
+    "scan_entry",
+    "trace_entry",
+]

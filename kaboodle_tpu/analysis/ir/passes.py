@@ -1,0 +1,286 @@
+"""KB401-KB404: the per-jaxpr graftscan passes.
+
+Each pass is ``check(entry, closed_jaxpr) -> [Finding]`` over one traced
+entry point (see ``registry.py`` for which trace — x32 or x64 — each pass
+reads). Findings reuse the AST lane's :class:`~kaboodle_tpu.analysis.core.
+Finding` so the baseline/CLI plumbing is shared verbatim:
+
+- ``path`` is the pseudo-path ``ir://<entry name>`` (entry points, not
+  files, are the unit of scanning);
+- ``line`` is the *source* line of the nearest user frame when attribution
+  succeeds (clickable in terminals), 0 otherwise;
+- ``symbol`` — the baseline key component — is line-free and built from
+  (source file, primitive, dtype/spec), so one justified entry covers a
+  finding class and survives unrelated edits, exactly like KB302's
+  one-entry-per-constructor design.
+"""
+
+from __future__ import annotations
+
+from kaboodle_tpu.analysis.core import Finding
+from kaboodle_tpu.analysis.ir.registry import EntryPoint
+from kaboodle_tpu.analysis.ir.walk import (
+    aval_nbytes,
+    eqn_avals,
+    iter_eqns,
+    iter_jaxprs,
+    source_of,
+)
+
+# -- KB401 ------------------------------------------------------------------
+
+# 64-bit float dtypes only: the rule is "any f64 anywhere" (ISSUE), traced
+# under enable_x64 so implicit defaults become visible. int64 is NOT swept —
+# jax.random's key plumbing and iota bookkeeping legitimately widen index
+# scalars under x64, and the int16 discipline has its own detector below.
+_WIDE_FLOATS = frozenset({"float64", "complex128"})
+
+# The lean int16 allowlisted accumulation set: a widened timer may feed age
+# arithmetic (`t - T` computes in int32 by design — kernel.py's documented
+# contract) and comparisons, and nothing else. A widened value reaching a
+# write (`select_n`), a scatter, a reduction carry, or escaping the scope
+# is a resident-doubling leak.
+_ALLOWED_WIDEN_CONSUMERS = frozenset(
+    {"sub", "add", "lt", "le", "gt", "ge", "eq", "ne"}
+)
+
+_INT16_WIDENED = frozenset({"int32", "int64", "float32", "float64"})
+
+
+def check_kb401_wide_floats(entry: EntryPoint, closed_jaxpr) -> list[Finding]:
+    """Non-scalar f64/c128 values in the x64 trace -> findings.
+
+    Scalars are exempt: a weak Python-float constant folding through a
+    ``where`` is trace noise, while an f64 tensor is a doubled resident."""
+    out: dict[str, Finding] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        for aval in eqn_avals(eqn):
+            dtype = str(getattr(aval, "dtype", ""))
+            if dtype not in _WIDE_FLOATS or not getattr(aval, "shape", ()):
+                continue
+            src = source_of(eqn)
+            symbol = f"{src.file}:{eqn.primitive.name}:{dtype}"
+            if symbol not in out:
+                out[symbol] = Finding(
+                    f"ir://{entry.name}",
+                    "KB401",
+                    src.line,
+                    f"{dtype} {list(aval.shape)} reaches '{eqn.primitive.name}' "
+                    f"({src.render()}) under x64 — spell the dtype "
+                    "(dtype=jnp.float32 / f32-pinned constants)",
+                    symbol,
+                )
+    return list(out.values())
+
+
+def check_kb401_lean_widening(entry: EntryPoint, closed_jaxpr) -> list[Finding]:
+    """int16 -> wider converts in a lean program, outside the allowlist.
+
+    Runs on the x32 trace of entries flagged ``lean=True``. The consumer
+    set of each widening convert is resolved through transparent ops and
+    pjit bodies (walk.terminal_consumers); any consumer outside the
+    age-arithmetic/comparison allowlist — including escaping the enclosing
+    scope — fails."""
+    if not entry.lean:
+        return []
+    from kaboodle_tpu.analysis.ir.walk import terminal_consumers
+
+    out: dict[str, Finding] = {}
+    for jaxpr in iter_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src_aval = getattr(eqn.invars[0], "aval", None)
+            dst_aval = getattr(eqn.outvars[0], "aval", None)
+            if src_aval is None or dst_aval is None:
+                continue
+            if str(src_aval.dtype) != "int16":
+                continue
+            if str(dst_aval.dtype) not in _INT16_WIDENED:
+                continue
+            consumers = terminal_consumers(jaxpr, eqn.outvars[0])
+            bad = consumers - _ALLOWED_WIDEN_CONSUMERS
+            if not bad:
+                continue
+            src = source_of(eqn)
+            symbol = f"{src.file}:int16->{dst_aval.dtype}:{'|'.join(sorted(bad))}"
+            if symbol not in out:
+                out[symbol] = Finding(
+                    f"ir://{entry.name}",
+                    "KB401",
+                    src.line,
+                    f"int16 state widened to {dst_aval.dtype} ({src.render()}) "
+                    f"flows into {sorted(bad)} — outside the age-arithmetic "
+                    "allowlist; the lean-mode timer resident must stay int16",
+                    symbol,
+                )
+    return list(out.values())
+
+
+# -- KB402 ------------------------------------------------------------------
+
+_HOST_PRIMS = frozenset(
+    {
+        "io_callback",
+        "pure_callback",
+        "debug_callback",
+        "callback",
+        "outside_call",
+        "host_callback_call",
+        "infeed",
+        "outfeed",
+    }
+)
+
+
+def check_kb402_host_boundary(entry: EntryPoint, closed_jaxpr) -> list[Finding]:
+    """Host-callback-shaped primitives anywhere in the traced program."""
+    out: dict[str, Finding] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name not in _HOST_PRIMS:
+            continue
+        src = source_of(eqn)
+        symbol = f"{src.file}:{name}"
+        if symbol not in out:
+            out[symbol] = Finding(
+                f"ir://{entry.name}",
+                "KB402",
+                src.line,
+                f"host boundary '{name}' ({src.render()}) inside a jitted "
+                "kernel program — one device->host round trip per dispatch",
+                symbol,
+            )
+    return list(out.values())
+
+
+# -- KB403 ------------------------------------------------------------------
+
+
+def check_kb403_captured_consts(entry: EntryPoint, closed_jaxpr) -> list[Finding]:
+    """Closure consts above the entry's byte budget baked into the program."""
+    out: list[Finding] = []
+    consts = getattr(closed_jaxpr, "consts", ()) or ()
+    for i, const in enumerate(consts):
+        aval = getattr(const, "aval", None)
+        if aval is None:
+            try:
+                import numpy as np
+
+                arr = np.asarray(const)
+                nbytes, shape, dtype = arr.nbytes, arr.shape, arr.dtype
+            except Exception:
+                continue
+        else:
+            nbytes, shape, dtype = aval_nbytes(aval), aval.shape, aval.dtype
+        if nbytes <= entry.const_budget_bytes:
+            continue
+        symbol = f"const:{dtype}{list(shape)}"
+        out.append(
+            Finding(
+                f"ir://{entry.name}",
+                "KB403",
+                0,
+                f"captured constant {dtype}{list(shape)} ({nbytes} bytes > "
+                f"budget {entry.const_budget_bytes}) baked into the program "
+                "— pass it as an argument instead",
+                symbol,
+            )
+        )
+    return out
+
+
+# -- KB404 ------------------------------------------------------------------
+
+
+def _normalize_spec(spec) -> tuple:
+    """PartitionSpec -> trailing-None-stripped tuple (rank-independent)."""
+    parts = tuple(spec)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return parts
+
+
+def allowed_sharding_specs() -> frozenset:
+    """Every spec derivable from ``parallel.state_specs`` (the single
+    source of truth): the peer-layer specs, their fleet-stacked 1-D and
+    2-D E x peers derivations, and the fleet inputs/knob specs."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from kaboodle_tpu.fleet.sharding import fleet_state_specs
+    from kaboodle_tpu.parallel.mesh import inputs_specs, state_specs
+
+    is_p = lambda x: isinstance(x, P)  # noqa: E731
+    specs = set()
+    for tree in (
+        state_specs(None),
+        inputs_specs(),
+        fleet_state_specs(None, peers_sharded=False),
+        fleet_state_specs(None, peers_sharded=True),
+    ):
+        for s in jax.tree.leaves(tree, is_leaf=is_p):
+            if is_p(s):
+                specs.add(_normalize_spec(s))
+    return frozenset(specs)
+
+
+def check_kb404_sharding_specs(entry: EntryPoint, closed_jaxpr) -> list[Finding]:
+    """Sharding constraints in sharded programs must derive from state_specs.
+
+    Flags (a) any ``sharding_constraint`` whose PartitionSpec is not in the
+    derivable set (hand-rolled layout), and (b) a sharded entry whose
+    program carries NO constraints at all (the carry placement is unpinned
+    and drifts wherever XLA's cost model wanders)."""
+    if not entry.sharded:
+        return []
+    allowed = allowed_sharding_specs()
+    out: dict[str, Finding] = {}
+    n_constraints = 0
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "sharding_constraint":
+            continue
+        n_constraints += 1
+        sharding = eqn.params.get("sharding")
+        spec = getattr(sharding, "spec", None)
+        if spec is None:  # GSPMD/opaque sharding: cannot verify derivation
+            norm = ("<opaque>",)
+        else:
+            norm = _normalize_spec(spec)
+        if spec is not None and norm in allowed:
+            continue
+        src = source_of(eqn)
+        symbol = f"{src.file}:spec:{norm}"
+        if symbol not in out:
+            out[symbol] = Finding(
+                f"ir://{entry.name}",
+                "KB404",
+                src.line,
+                f"sharding constraint {spec!r} ({src.render()}) is not "
+                "derivable from parallel.state_specs — hand-rolled layouts "
+                "force per-tick resharding collectives",
+                symbol,
+            )
+    if n_constraints == 0:
+        out["<missing>"] = Finding(
+            f"ir://{entry.name}",
+            "KB404",
+            0,
+            "sharded entry point compiles with NO sharding constraints — "
+            "the scan-carry placement is unpinned (constrain_state missing?)",
+            "missing-constraints",
+        )
+    return list(out.values())
+
+
+# -- pass pipeline ----------------------------------------------------------
+
+# (pass fn, which trace it reads). KB401's f64 sweep wants the x64 trace;
+# everything else audits the production-mode x32 program.
+PASSES_X32 = (
+    check_kb401_lean_widening,
+    check_kb402_host_boundary,
+    check_kb403_captured_consts,
+    check_kb404_sharding_specs,
+)
+PASSES_X64 = (check_kb401_wide_floats,)

@@ -1,0 +1,301 @@
+"""The graftscan entry-point registry: every traced kernel the gate audits.
+
+One :class:`EntryPoint` per compiled-program family the simulator actually
+dispatches in production: the dense tick (faulty / fast-path / lean-int16 /
+random-draw variants), the chunked row-blocked twin, the warp leap scan,
+the vmapped fleet tick, the fused ops + crc32 primitives, and the
+GSPMD-sharded twins. Each entry knows how to build ``(fn, example_args)``
+at **toy trace scale** — tracing is abstract evaluation, so N=32 exercises
+the identical program structure the production N=65,536 program has, at
+AST-adjacent cost.
+
+``build()`` is called inside the tracing context (per pass: default x32 or
+``enable_x64``), so example states are constructed under the flag being
+audited; everything dtype-pinned stays pinned, and only implicit defaults
+drift — which is exactly what KB401 measures.
+
+Entries are deliberately *data*: tests register synthetic/mutated entries
+through the same type to prove the passes catch seeded regressions, and
+``--entries a,b`` filters by name for fast local iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+TRACE_N = 32  # toy trace scale; program structure is N-independent
+TRACE_E = 4  # fleet ensemble width at trace scale
+LEAP_K = 8  # leap span length traced (one representative power of two)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One traced kernel entry point.
+
+    ``build()`` returns ``(fn, example_args)`` for ``jax.make_jaxpr``.
+    ``lean`` opts the program into the KB401 int16-widening pass;
+    ``sharded`` opts it into KB404. ``const_budget_bytes`` is the KB403
+    threshold — sized to catch an [N, N]-at-trace-scale capture (int8
+    state at N=32 is 1 KiB; the crc32 table, the one legitimate big-ish
+    table, is 1 KiB and rides under the default with headroom to spare
+    only via its explicit override)."""
+
+    name: str
+    build: Callable[[], tuple[Callable, tuple]]
+    lean: bool = False
+    sharded: bool = False
+    const_budget_bytes: int = 4096
+
+
+def _cfg(**kw):
+    from kaboodle_tpu.config import SwimConfig
+
+    return SwimConfig(deterministic=True, **kw)
+
+
+def _full_state(n: int = TRACE_N):
+    from kaboodle_tpu.sim.state import init_state
+
+    return init_state(n, seed=0)
+
+
+def _lean_state(n: int = TRACE_N, converged: bool = False):
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.sim.state import init_state
+
+    return init_state(
+        n,
+        seed=0,
+        timer_dtype=jnp.int16,
+        track_latency=False,
+        instant_identity=True,
+        ring_contacts=n - 1 if converged else 0,
+        announced=converged,
+    )
+
+
+def _converged_state(n: int = TRACE_N):
+    from kaboodle_tpu.sim.state import init_state
+
+    return init_state(n, seed=0, ring_contacts=n - 1, announced=True)
+
+
+def _idle(n: int = TRACE_N):
+    from kaboodle_tpu.sim.state import idle_inputs
+
+    return idle_inputs(n)
+
+
+# -- builders ---------------------------------------------------------------
+
+
+def _dense_faulty():
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    return make_tick_fn(_cfg(), faulty=True), (_full_state(), _idle())
+
+
+def _dense_fastpath():
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    return make_tick_fn(_cfg(), faulty=False), (_full_state(), _idle())
+
+
+def _dense_lean():
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    return make_tick_fn(_cfg(), faulty=False), (_lean_state(), _idle())
+
+
+def _dense_random():
+    # deterministic=False exercises the real sampling draws (gumbel /
+    # bernoulli / uniform) — where dtype-less defaults hide.
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    cfg = SwimConfig(deterministic=False)
+    return make_tick_fn(cfg, faulty=True), (_full_state(), _idle())
+
+
+def _chunked():
+    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+
+    fn = make_chunked_tick_fn(_cfg(), faulty=True, block=TRACE_N // 2)
+    return fn, (_full_state(), _idle())
+
+
+def _warp_leap():
+    from kaboodle_tpu.warp.leap import make_leap_fn
+
+    return make_leap_fn(_cfg(), LEAP_K), (_converged_state(),)
+
+
+def _warp_leap_lean():
+    from kaboodle_tpu.warp.leap import make_leap_fn
+
+    return make_leap_fn(_cfg(), LEAP_K), (_lean_state(converged=True),)
+
+
+def _fleet_tick():
+    from kaboodle_tpu.fleet.core import (
+        fleet_idle_inputs,
+        init_fleet,
+        make_fleet_tick_fn,
+    )
+
+    fleet = init_fleet(TRACE_N // 2, TRACE_E)
+    inputs = fleet_idle_inputs(TRACE_N // 2, TRACE_E)
+    return make_fleet_tick_fn(_cfg(), faulty=True), (fleet.mesh, inputs)
+
+
+def _sharded_tick():
+    from kaboodle_tpu.parallel.mesh import make_mesh, make_sharded_tick
+
+    mesh = make_mesh(len(_devices()))
+    return make_sharded_tick(_cfg(), mesh, faulty=False), (_full_state(), _idle())
+
+
+def _sharded_leap():
+    import jax
+
+    from kaboodle_tpu.parallel.mesh import (
+        constrain_state,
+        make_mesh,
+        row_matrix_sharding,
+    )
+    from kaboodle_tpu.warp.leap import make_leap_fn
+
+    mesh = make_mesh(len(_devices()))
+    sharding = row_matrix_sharding(mesh)
+    leap = make_leap_fn(
+        _cfg(), LEAP_K, constrain=lambda x: jax.lax.with_sharding_constraint(x, sharding)
+    )
+
+    def sharded_leap(st):
+        return constrain_state(leap(st), mesh)
+
+    return sharded_leap, (_converged_state(),)
+
+
+def _sharded_fleet_tick():
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
+    from kaboodle_tpu.fleet.sharding import make_fleet_mesh, make_sharded_fleet_tick
+
+    mesh = make_fleet_mesh(len(_devices()))
+    e = max(TRACE_E, len(_devices()))  # E must divide across the mesh
+    fleet = init_fleet(TRACE_N // 2, e)
+    inputs = fleet_idle_inputs(TRACE_N // 2, e)
+    return make_sharded_fleet_tick(_cfg(), mesh, faulty=True), (fleet.mesh, inputs)
+
+
+def _ops_fused_fp():
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.ops.fused_fp import fused_fp_count
+
+    n = 128  # fused kernels require lane alignment (n % 128 == 0)
+    return (
+        lambda s, i: fused_fp_count(s, i),
+        (jnp.zeros((n, n), jnp.int8), jnp.ones((n,), jnp.uint32)),
+    )
+
+
+def _ops_fused_oldest_k():
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k
+
+    n = 128
+    return (
+        lambda s, t, a: fused_oldest_k(s, t, a, 5),
+        (
+            jnp.zeros((n, n), jnp.int8),
+            jnp.zeros((n, n), jnp.int32),
+            jnp.ones((n,), bool),
+        ),
+    )
+
+
+def _ops_fused_suspicion():
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.ops.fused_suspicion import fused_suspicion
+
+    n = 128
+    return (
+        lambda s, t, a, th: fused_suspicion(s, t, a, th),
+        (
+            jnp.zeros((n, n), jnp.int8),
+            jnp.zeros((n, n), jnp.int32),
+            jnp.ones((n,), bool),
+            jnp.int32(0),
+        ),
+    )
+
+
+def _ops_crc32():
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.ops.crc32 import membership_crc32
+
+    n = 16  # the byte scan is O(N) eqns; keep the trace small
+    return (
+        membership_crc32,
+        (jnp.ones((n, n), bool), jnp.ones((n,), jnp.uint32)),
+    )
+
+
+def _devices():
+    import jax
+
+    return jax.devices()
+
+
+ENTRY_POINTS: tuple[EntryPoint, ...] = (
+    EntryPoint("sim.tick.dense.faulty", _dense_faulty),
+    EntryPoint("sim.tick.dense.fastpath", _dense_fastpath),
+    EntryPoint("sim.tick.dense.lean", _dense_lean, lean=True),
+    EntryPoint("sim.tick.dense.random", _dense_random),
+    EntryPoint("sim.tick.chunked", _chunked),
+    EntryPoint("warp.leap", _warp_leap),
+    EntryPoint("warp.leap.lean", _warp_leap_lean, lean=True),
+    EntryPoint("fleet.tick", _fleet_tick),
+    EntryPoint("parallel.tick.sharded", _sharded_tick, sharded=True),
+    EntryPoint("warp.leap.sharded", _sharded_leap, sharded=True),
+    EntryPoint("fleet.tick.sharded", _sharded_fleet_tick, sharded=True),
+    EntryPoint("ops.fused_fp", _ops_fused_fp),
+    EntryPoint("ops.fused_oldest_k", _ops_fused_oldest_k),
+    EntryPoint("ops.fused_suspicion", _ops_fused_suspicion),
+    EntryPoint("ops.crc32", _ops_crc32, const_budget_bytes=2048),
+)
+
+
+def select_entries(names: Sequence[str] | None) -> tuple[EntryPoint, ...]:
+    """The registry, optionally filtered to the named entries (exact match)."""
+    if not names:
+        return ENTRY_POINTS
+    by_name = {e.name: e for e in ENTRY_POINTS}
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        raise KeyError(f"unknown entry point(s): {', '.join(missing)}")
+    return tuple(by_name[n] for n in names)
+
+
+def trace_entry(entry: EntryPoint, x64: bool = False):
+    """``ClosedJaxpr`` of one entry, optionally under ``jax_enable_x64``.
+
+    The build runs inside the flag context so implicit-default dtypes
+    drift exactly as they would in a production x64 process — the KB401
+    detection surface. Pinned dtypes are flag-invariant, so the x32 trace
+    (used by KB402-404) and the x64 trace share structure."""
+    import contextlib
+
+    import jax
+    from jax.experimental import enable_x64
+
+    ctx = enable_x64() if x64 else contextlib.nullcontext()
+    with ctx:
+        fn, args = entry.build()
+        return jax.make_jaxpr(fn)(*args)

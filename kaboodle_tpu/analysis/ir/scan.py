@@ -1,0 +1,102 @@
+"""The graftscan driver: trace the registry, run the passes, gate the debt.
+
+``run_scan`` is the one entry the CLI (and the mutation tests) call. It
+returns every finding — KB401-404 from the per-entry traces, KB405 from
+the compile-surface exercise — *before* baseline filtering, so the CLI
+applies the exact same baseline/no-growth plumbing the AST lane uses.
+
+jax is imported here (lazily, CPU-pinned): the default AST lint lane never
+reaches this module, so ``make lint``'s first line stays backend-free and
+the analysis tests keep their parse-speed fast lane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+from kaboodle_tpu.analysis.core import Finding
+from kaboodle_tpu.analysis.ir.registry import EntryPoint, select_entries, trace_entry
+
+
+def _prepare_backend() -> None:
+    """CPU-pin jax before first import: the scan is a lint gate, not a
+    workload — tracing is backend-independent and must never wedge on (or
+    warm up) an accelerator. Explicit JAX_PLATFORMS wins."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from axon_guard import strip_axon_plugin
+
+        strip_axon_plugin()
+    except ImportError:  # installed-package runs outside the repo root
+        pass
+
+
+@dataclasses.dataclass
+class ScanResult:
+    findings: list[Finding]
+    surface_measured: dict[str, int]
+    entries_scanned: int
+
+
+def scan_entry(entry: EntryPoint) -> list[Finding]:
+    """KB401-404 findings for one entry point (both traces).
+
+    An x32 trace failure is a broken entry (propagates — the registry must
+    always trace in production mode); an x64 trace failure IS a KB401
+    finding: the program contains a dtype that only holds together under
+    the 32-bit defaults (e.g. an unpinned integer accumulator that int64
+    widening snaps against a pinned ref)."""
+    from kaboodle_tpu.analysis.ir.passes import PASSES_X32, PASSES_X64
+
+    findings: list[Finding] = []
+    x32 = trace_entry(entry, x64=False)
+    for p in PASSES_X32:
+        findings.extend(p(entry, x32))
+    try:
+        x64 = trace_entry(entry, x64=True)
+    except Exception as e:  # noqa: BLE001 — any trace-time error qualifies
+        findings.append(
+            Finding(
+                f"ir://{entry.name}",
+                "KB401",
+                0,
+                "entry fails to trace under x64 — an implicit dtype widens "
+                f"until the program is inconsistent: {type(e).__name__}: "
+                f"{str(e).splitlines()[0] if str(e) else ''}",
+                "x64-trace-error",
+            )
+        )
+    else:
+        for p in PASSES_X64:
+            findings.extend(p(entry, x64))
+    return findings
+
+
+def run_scan(
+    entry_names: Sequence[str] | None = None,
+    entries: Sequence[EntryPoint] | None = None,
+    with_surface: bool = True,
+    progress=None,
+) -> ScanResult:
+    """Trace + audit the registry (or injected ``entries``), then measure
+    the compile surface. ``progress(msg)`` gets one line per phase."""
+    _prepare_backend()
+    from kaboodle_tpu.analysis.ir import surface as surface_mod
+
+    chosen = entries if entries is not None else select_entries(entry_names)
+    findings: list[Finding] = []
+    for entry in chosen:
+        if progress:
+            progress(f"graftscan: tracing {entry.name}")
+        findings.extend(scan_entry(entry))
+
+    measured: dict[str, int] = {}
+    if with_surface:
+        if progress:
+            progress("graftscan: measuring compile surface (dense+warp+fleet)")
+        measured = surface_mod.measure_surface()
+
+    findings.sort(key=lambda f: (f.path, f.rule, f.symbol))
+    return ScanResult(findings, measured, len(chosen))

@@ -1,0 +1,394 @@
+"""KB405: the compile-surface budget — count programs, gate growth.
+
+Memoization-based simulators get their speed from a small, stable set of
+compiled programs; a recompilation storm (a spurious static arg, a shape
+that varies per call, a span-chunking policy that degrades to one program
+per span length) is invisible to unit tests — everything still passes,
+just N times slower. This module:
+
+- provides :func:`compile_counter`, a context manager counting *fresh* XLA
+  compilations via jax's monitoring events (cache hits do not fire) —
+  shared with the parity fuzz's zero-recompile assertion arm
+  (tests/test_fuzz_parity.py);
+- defines the scripted dense+warp+fleet **exercise** — a fixed sequence of
+  representative dispatches per entry-point family — and measures how many
+  compilations each family triggers;
+- loads/writes the committed budget ``.graftscan_surface.json`` and turns
+  measured-vs-committed deltas into KB405 findings.
+
+The committed counts are only meaningful for a FRESH process running the
+whole script in order (eager-op caches warm deterministically along the
+way), which is exactly how ``make lint`` / CI invoke the scan. In-process
+callers (tests) use the measurement machinery with their own synthetic
+exercises and baselines instead of asserting the committed numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import pathlib
+from typing import Callable, Iterator, Sequence
+
+from kaboodle_tpu.analysis.core import BaselineError, Finding
+
+DEFAULT_SURFACE = ".graftscan_surface.json"
+
+# The event jax records once per fresh backend compilation (never on a
+# jit/pjit cache hit) — see jax._src.compiler.
+_COMPILE_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+
+@dataclasses.dataclass
+class CompileCount:
+    count: int = 0
+
+
+_active_counters: list[CompileCount] = []
+_listener_registered = False
+
+
+def _listener(name: str, **kw) -> None:
+    if name == _COMPILE_EVENT:
+        for box in _active_counters:
+            box.count += 1
+
+
+@contextlib.contextmanager
+def compile_counter() -> Iterator[CompileCount]:
+    """Count fresh XLA compilations inside the block (cache hits: zero).
+
+    Nestable; the singleton monitoring listener stays registered for the
+    life of the process (jax's listener list has no compaction — repeated
+    register/unregister cycles would leak)."""
+    global _listener_registered
+    from jax._src import monitoring
+
+    if not _listener_registered:
+        monitoring.register_event_listener(_listener)
+        _listener_registered = True
+    box = CompileCount()
+    _active_counters.append(box)
+    try:
+        yield box
+    finally:
+        _active_counters.remove(box)
+
+
+def assert_counter_live() -> None:
+    """Fail loudly if the compile-event stream is dead in this process.
+
+    jax only records the monitored event when its compilation-cache
+    machinery is engaged for the backend; with it disabled (e.g.
+    ``JAX_ENABLE_COMPILATION_CACHE=0``) every exercise would measure 0 and
+    the KB405 gate — and any zero-recompile assertion — would be vacuous
+    (or, worse, advise committing zero budgets that then fail elsewhere as
+    growth). A sentinel compile (a fresh closure, so never jit-cached)
+    must register exactly one event before any measurement is trusted."""
+    import jax
+    import jax.numpy as jnp
+
+    with compile_counter() as box:
+        jax.jit(lambda x: x + 1)(jnp.zeros((3,), jnp.float32))
+    if box.count == 0:
+        raise RuntimeError(
+            "compile-event stream is dead (jax compilation cache disabled?) "
+            "— compile-surface counts would be vacuously 0; re-enable the "
+            "cache (unset JAX_ENABLE_COMPILATION_CACHE=0) to run this gate"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the scripted exercise
+
+_EX_N = 32  # exercise mesh size (CPU-friendly; program count is N-free)
+_EX_E = 4  # fleet ensemble width
+
+
+@dataclasses.dataclass(frozen=True)
+class SurfaceExercise:
+    """One entry-point family: a scripted run whose compile count is gated.
+
+    ``prep()`` builds states/inputs (its eager-op compilations are NOT
+    counted); ``run(ctx)`` dispatches the entry points under the counter.
+    Host-driven runners (warp) still compile a few eager helpers inside
+    ``run`` — those are part of their real dispatch surface and count."""
+
+    name: str
+    prep: Callable[[], object]
+    run: Callable[[object], None]
+
+
+def _cfg():
+    from kaboodle_tpu.config import SwimConfig
+
+    return SwimConfig(deterministic=True)
+
+
+def _prep_dense():
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    cfg = _cfg()
+    n = _EX_N
+    idle = idle_inputs(n)
+    variants = (
+        idle,
+        dc.replace(idle, kill=idle.kill.at[1].set(True)),
+        dc.replace(idle, revive=idle.revive.at[1].set(True)),
+        dc.replace(idle, manual_target=idle.manual_target.at[0].set(3)),
+        dc.replace(idle, drop_rate=jnp.float32(0.1)),
+    )
+    return {
+        "tick": jax.jit(make_tick_fn(cfg, faulty=True)),
+        "fast": jax.jit(make_tick_fn(cfg, faulty=False)),
+        "lean": jax.jit(make_tick_fn(cfg, faulty=False)),
+        "st": init_state(n, seed=0),
+        "stf": init_state(n, seed=1),
+        "stl": init_state(
+            n, seed=2, timer_dtype=jnp.int16, track_latency=False,
+            instant_identity=True,
+        ),
+        "idle": idle,
+        "variants": variants,
+    }
+
+
+def _run_dense(ctx) -> None:
+    """The dense tick across its input envelope: idle / kill / revive /
+    manual / nonzero drop all share ONE faulty program; the fault-free and
+    lean builds are one program each. Five shapes-identical dispatches per
+    jit prove the cache holds: budget = 3."""
+    st = ctx["st"]
+    for inp in ctx["variants"]:
+        st, _ = ctx["tick"](st, inp)
+    stf, stl = ctx["stf"], ctx["stl"]
+    for _ in range(2):
+        stf, _ = ctx["fast"](stf, ctx["idle"])
+    for _ in range(2):
+        stl, _ = ctx["lean"](stl, ctx["idle"])
+
+
+def _prep_warp():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs, init_state
+    from kaboodle_tpu.warp import runner
+
+    # The runner memoizes jitted programs process-wide; start this exercise
+    # from a cold runner cache so the count is the runner's real surface.
+    runner._dense_tick.cache_clear()
+    runner._leap.cache_clear()
+    runner._converged.cache_clear()
+
+    n = _EX_N
+    ticks = 24
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    kill[8, 1] = True  # one mid-run fault: leap -> dense window -> leap
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=idle.revive,
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=idle.manual_target,
+        drop_ok=None,
+    )
+    return {
+        "st": init_state(n, seed=0, ring_contacts=n - 1, announced=True),
+        "inputs": inputs,
+    }
+
+
+def _run_warp(ctx) -> None:
+    """The warp runner over a converged mesh and a sparse-fault schedule:
+    the dense tick + convergence/quiescence checks + the power-of-two
+    leap-chunk programs (plus the runner's own host-side eager helpers —
+    slicing, predicate fetches — which are part of its dispatch surface).
+    Two run lengths whose span decompositions share chunks (48 = 32+16,
+    44 = 32+8+4) prove the power-of-two policy bounds the cache; the
+    regression this guards is one program per distinct span length."""
+    from kaboodle_tpu.warp.runner import run_warped, simulate_warped
+
+    cfg = _cfg()
+    st = ctx["st"]
+    run_warped(st, cfg, ticks=48, recheck_every=8)
+    run_warped(st, cfg, ticks=44, recheck_every=8)
+    simulate_warped(st, ctx["inputs"], cfg, faulty=True, recheck_every=8)
+
+
+def _prep_fleet():
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
+
+    n, e = _EX_N // 2, _EX_E
+    return {
+        "fleet": init_fleet(n, e),
+        "fleet2": init_fleet(n, e, drop_rates=jnp.linspace(0.0, 0.2, e)),
+        "inputs": fleet_idle_inputs(n, e, ticks=4),
+    }
+
+
+def _run_fleet(ctx) -> None:
+    """The vmapped ensemble: one scan program advances all members; a
+    second dispatch with different per-member knob values must hit the
+    cache (knobs are traced, not static): budget = 1."""
+    from kaboodle_tpu.fleet.core import simulate_fleet
+
+    cfg = _cfg()
+    simulate_fleet(ctx["fleet"], ctx["inputs"], cfg, faulty=True)
+    simulate_fleet(ctx["fleet2"], ctx["inputs"], cfg, faulty=True)
+
+
+EXERCISES: tuple[SurfaceExercise, ...] = (
+    SurfaceExercise("dense", _prep_dense, _run_dense),
+    SurfaceExercise("warp", _prep_warp, _run_warp),
+    SurfaceExercise("fleet", _prep_fleet, _run_fleet),
+)
+
+
+def measure_surface(
+    exercises: Sequence[SurfaceExercise] | None = None,
+) -> dict[str, int]:
+    """Run the scripted exercises in order; fresh compiles per family.
+
+    Prep (state/input construction) runs outside the counter, so the
+    committed numbers track entry-point programs, not eager setup noise."""
+    assert_counter_live()
+    out: dict[str, int] = {}
+    for ex in exercises if exercises is not None else EXERCISES:
+        ctx = ex.prep()
+        with compile_counter() as box:
+            ex.run(ctx)
+        out[ex.name] = box.count
+    return out
+
+
+# ---------------------------------------------------------------------------
+# committed budget file
+
+
+def load_surface(path: pathlib.Path) -> dict[str, tuple[int, str]]:
+    """entry -> (programs, reason). Missing file = empty budget; malformed
+    entries (no name/count/justification) are hard errors, like the lint
+    baseline — the justification is the point of the file."""
+    if not path.exists():
+        return {}
+    try:
+        data = json.loads(path.read_text())
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"{path}: invalid JSON: {e}") from e
+    entries = data.get("entries") if isinstance(data, dict) else None
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: expected an object with an 'entries' list")
+    out: dict[str, tuple[int, str]] = {}
+    for i, e in enumerate(entries):
+        if (
+            not isinstance(e, dict)
+            or not e.get("entry")
+            or not isinstance(e.get("programs"), int)
+            or not str(e.get("reason", "")).strip()
+        ):
+            raise BaselineError(
+                f"{path}: entries[{i}] needs 'entry', integer 'programs', and "
+                "a non-empty 'reason' justification"
+            )
+        out[str(e["entry"])] = (int(e["programs"]), str(e["reason"]))
+    return out
+
+
+def write_surface(
+    path: pathlib.Path,
+    measured: dict[str, int],
+    old: dict[str, tuple[int, str]],
+) -> None:
+    """Regenerate the budget from measured counts, keeping old reasons."""
+    payload = {
+        "comment": (
+            "graftscan compile-surface budget: distinct XLA compilations per "
+            "entry-point family across the scripted dense+warp+fleet exercise "
+            "(fresh process — `python -m kaboodle_tpu.analysis --ir`). CI "
+            "fails on growth; raising a count requires editing this file "
+            "with a justification. Shrink when the measured count drops."
+        ),
+        "entries": [
+            {
+                "entry": name,
+                "programs": count,
+                "reason": old.get(name, (0, "TODO: justify this budget"))[1],
+            }
+            for name, count in sorted(measured.items())
+        ],
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def surface_findings(
+    measured: dict[str, int],
+    committed: dict[str, tuple[int, str]],
+    no_growth: bool = False,
+) -> list[Finding]:
+    """Measured-vs-committed deltas as KB405 findings.
+
+    Growth (or a family missing from the file) always fails; shrink fails
+    only under ``--no-baseline-growth`` — the same monotonic-debt contract
+    as the lint baseline, so the committed counts can only ratchet down."""
+    out: list[Finding] = []
+    for name, count in sorted(measured.items()):
+        if name not in committed:
+            out.append(
+                Finding(
+                    f"ir://surface.{name}",
+                    "KB405",
+                    0,
+                    f"no committed budget for '{name}' ({count} programs "
+                    "measured) — run --write-surface and justify the entry",
+                    f"surface:{name}:missing",
+                )
+            )
+            continue
+        budget, _reason = committed[name]
+        if count > budget:
+            out.append(
+                Finding(
+                    f"ir://surface.{name}",
+                    "KB405",
+                    0,
+                    f"compile surface grew: {count} programs vs committed "
+                    f"{budget} — a recompilation regression, or raise the "
+                    "budget in .graftscan_surface.json with a justification",
+                    f"surface:{name}:growth",
+                )
+            )
+        elif count < budget and no_growth:
+            out.append(
+                Finding(
+                    f"ir://surface.{name}",
+                    "KB405",
+                    0,
+                    f"compile surface shrank: {count} programs vs committed "
+                    f"{budget} — commit the smaller count (--write-surface)",
+                    f"surface:{name}:stale",
+                )
+            )
+    if no_growth:
+        for name in sorted(set(committed) - set(measured)):
+            out.append(
+                Finding(
+                    f"ir://surface.{name}",
+                    "KB405",
+                    0,
+                    f"stale surface entry '{name}': family no longer measured "
+                    "— delete it from .graftscan_surface.json",
+                    f"surface:{name}:orphan",
+                )
+            )
+    return out

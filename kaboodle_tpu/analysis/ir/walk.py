@@ -1,0 +1,158 @@
+"""Jaxpr traversal + source attribution shared by every graftscan pass.
+
+A traced entry point is a ``ClosedJaxpr`` whose equations nest more jaxprs
+inside their params (``pjit`` bodies, ``scan``/``while``/``cond`` branches,
+custom-call subcomputations). Every pass wants the same two things:
+
+- a flat walk over ALL equations, wherever they nest
+  (:func:`iter_eqns`), and
+- a stable, human-meaningful location for a finding
+  (:func:`source_of`): the nearest *user* frame (repo code, not jax
+  internals) of the equation's traceback. Baseline keys embed the file
+  basename but not the line, so entries survive unrelated edits — the same
+  key discipline as the AST lane's ``Finding.key``.
+
+Consumer analysis (:func:`terminal_consumers`) answers "what ultimately
+uses this value" for the KB401 int16-widening allowlist: structural ops
+that merely move data (broadcast/reshape/transpose/...) are transparent,
+and a ``pjit`` consumer is resolved into its body. Everything here is
+read-only over jax's public jaxpr surface (``eqns``/``invars``/``outvars``/
+``params``) plus ``source_info``, guarded so a jax upgrade degrades
+attribution to "<unknown>" instead of crashing the gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+
+def _sub_jaxprs(params: dict) -> Iterator:
+    """Every (Closed)Jaxpr nested in an equation's params."""
+    for p in params.values():
+        for sub in p if isinstance(p, (list, tuple)) else [p]:
+            inner = getattr(sub, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner  # ClosedJaxpr -> its Jaxpr
+            elif hasattr(sub, "eqns"):
+                yield sub  # bare Jaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Depth-first over every equation of ``jaxpr`` and its sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def iter_jaxprs(jaxpr) -> Iterator:
+    """``jaxpr`` plus every nested sub-jaxpr (for per-scope analyses)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_jaxprs(sub)
+
+
+def eqn_avals(eqn) -> list:
+    """Abstract values of an equation's inputs + outputs (literals included)."""
+    out = []
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            out.append(aval)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Source:
+    """Nearest user frame of an equation: repo file + line (best effort)."""
+
+    file: str  # basename, "<unknown>" when attribution failed
+    line: int  # 0 when unknown
+
+    def render(self) -> str:
+        return self.file if not self.line else f"{self.file}:{self.line}"
+
+
+_UNKNOWN = Source("<unknown>", 0)
+
+
+def source_of(eqn) -> Source:
+    """The equation's nearest non-jax-internal frame.
+
+    ``source_info_util.user_frame`` already filters jax library frames, so a
+    64-bit draw inside ``jax.random`` attributes to the repo call site that
+    asked for it — the line the fix belongs on. Private API, so any failure
+    degrades to ``<unknown>`` rather than failing the scan."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return _UNKNOWN
+        return Source(frame.file_name.replace("\\", "/").rsplit("/", 1)[-1], frame.start_line)
+    except Exception:
+        return _UNKNOWN
+
+
+# ---------------------------------------------------------------------------
+# consumer analysis (KB401 lean-widening allowlist)
+
+# Ops that move/reshape a value without computing on it: consumers *through*
+# these are the ones that matter for the widening allowlist.
+TRANSPARENT_PRIMS = frozenset(
+    {
+        "broadcast_in_dim",
+        "reshape",
+        "transpose",
+        "squeeze",
+        "expand_dims",
+        "slice",
+        "rev",
+        "copy",
+    }
+)
+
+
+def terminal_consumers(jaxpr, var, _depth: int = 0) -> set[str]:
+    """Primitive names that ultimately consume ``var`` within ``jaxpr``.
+
+    Transparent ops are traversed (their outputs' consumers substitute for
+    them); a ``pjit`` consumer resolves into its body via the matching
+    parameter. A value escaping as a (sub-)jaxpr output reports the
+    sentinel ``"<jaxpr-output>"`` — callers treat escape as
+    not-allowlisted, because the pass cannot see what the parent does with
+    it."""
+    out: set[str] = set()
+    if _depth > 16:  # defensive: malformed/cyclic structures
+        return {"<depth-limit>"}
+    for eqn in jaxpr.eqns:
+        if not any(v is var for v in eqn.invars):
+            continue
+        name = eqn.primitive.name
+        if name in TRANSPARENT_PRIMS:
+            out |= terminal_consumers(jaxpr, eqn.outvars[0], _depth + 1)
+        elif name == "pjit":
+            inner = eqn.params["jaxpr"].jaxpr
+            for pos, v in enumerate(eqn.invars):
+                if v is var and pos < len(inner.invars):
+                    out |= terminal_consumers(inner, inner.invars[pos], _depth + 1)
+        else:
+            out.add(name)
+    if any(v is var for v in jaxpr.outvars):
+        out.add("<jaxpr-output>")
+    return out
+
+
+def aval_nbytes(aval) -> int:
+    """Concrete byte size of a shaped aval (0 for abstract/opaque ones)."""
+    try:
+        import numpy as np
+
+        size = 1
+        for d in aval.shape:
+            size *= int(d)
+        return size * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0

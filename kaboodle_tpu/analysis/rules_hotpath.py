@@ -26,6 +26,13 @@ HOT_DIRS = (
     "kaboodle_tpu/ops/",
     "kaboodle_tpu/fleet/",
     "kaboodle_tpu/warp/",
+    # The oracle is host-side by design (pure-Python lockstep semantics),
+    # but its jnp surfaces (fingerprint.py) feed the parity suites that
+    # pin kernel bit-exactness: a dtype drift THERE silently re-defines
+    # what "exact" means, so the dtype-discipline rules cover it too.
+    # KB301 is reachability-scoped, so the oracle's intentional host numpy
+    # (untraced code) does not fire.
+    "kaboodle_tpu/oracle/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
@@ -42,6 +49,9 @@ DTYPE_DISCIPLINE_FILES = (
     "crc32.py", "hashing.py", "kernel.py", "chunked.py", "state.py", "sampling.py",
     "core.py", "stats.py",
     "horizon.py", "leap.py", "runner.py",
+    # oracle/: the reference-semantics twins whose fingerprints the parity
+    # suites compare against the kernels' — wrong dtype = wrong oracle.
+    "fingerprint.py", "engine.py", "lockstep.py",
 )
 
 _CONSTRUCTORS = {

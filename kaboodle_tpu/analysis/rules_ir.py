@@ -1,0 +1,147 @@
+"""KB4xx — graftscan: jaxpr/IR-level rules over the traced kernel programs.
+
+The KB1xx-KB3xx families read *source*; this family reads the *traced
+program*. The properties the north-star budget actually depends on — no
+dtype widening in the lean int16 path, no host syncs inside jitted tick
+kernels, no silent recompilation storms across warp leap spans — live in
+the ``ClosedJaxpr``, not the AST: a ``jax.random.uniform`` without a dtype
+parses identically either way, but the traced program shows the f64 [N, N]
+draw it becomes once ``jax_enable_x64`` flips.
+
+This module registers only the rule *documentation* (``--explain KB4nn``,
+``--list-rules``) with no-op AST checks, so the default AST lane stays
+jax-free and parse-fast. The actual passes live in
+``kaboodle_tpu/analysis/ir/`` (which imports jax) and run under
+``python -m kaboodle_tpu.analysis --ir`` over the entry-point registry
+(``ir/registry.py``): the dense/chunked tick kernels, the warp leap scan,
+the vmapped fleet tick, the fused ops + crc32, and the GSPMD-sharded twins.
+
+IR findings have no source line to ``# noqa``; suppression is the justified
+baseline ``.graftscan_baseline.json`` (same format and shrink-only debt
+contract as ``.graftlint_baseline.json``), and the KB405 program counts are
+pinned by ``.graftscan_surface.json``.
+"""
+
+from __future__ import annotations
+
+from kaboodle_tpu.analysis.core import rule
+
+
+def _ir_only(mod):
+    """KB4xx rules run on traced jaxprs (analysis/ir/), never on ASTs."""
+    return []
+
+
+rule(
+    "KB401",
+    "dtype widening in a traced kernel program",
+    """
+Two detectors over each registered entry point's ClosedJaxpr:
+
+1. Any non-scalar float64/complex128 value anywhere in the program, traced
+   under `jax_enable_x64`. With x64 off, a dtype-less draw or a bare-float
+   promotion silently lands on f32 and the program *looks* fine; flipping
+   x64 during the trace makes every implicit default visible. A clean
+   program pins every tensor dtype, so it traces 32-bit under either flag;
+   an f64 [N, N] resident doubles the HBM bill of exactly the tensors the
+   MEMORY_PLAN budget is spent on.
+2. In the lean-mode programs (int16 timers — MEMORY_PLAN.md), any
+   `convert_element_type` that widens int16 state beyond the allowlisted
+   accumulation set: age arithmetic (`t - T` computes in int32 by design)
+   and comparisons. A widened value flowing into anything else — a state
+   write, a scatter, a carry — silently doubles the timer resident and
+   breaks the int16 discipline the lean mode exists for.
+
+Fix by spelling the dtype (`dtype=jnp.float32` on draws, f32-pinned
+probability constants); baseline with a justification only for widenings
+that are provably trace-local.
+""",
+)(_ir_only)
+
+
+rule(
+    "KB402",
+    "host boundary inside a jitted tick program",
+    """
+A host-callback-shaped primitive (`io_callback`, `pure_callback`,
+`debug_callback`, infeed/outfeed) reachable inside a registered entry
+point's traced program. Each one forces a device->host round trip per
+dispatch — inside a tick kernel that `lax.scan` rolls thousands of times,
+or a warp leap that exists to *avoid* per-tick dispatches, a single
+callback stalls the whole pipeline (and under `vmap` it stalls all E fleet
+members at once). Debug prints belong outside the kernel or behind a
+non-default debug build; data extraction belongs in TickMetrics, which the
+scan stacks on-device for free.
+
+The AST-level twin (KB301) catches host syncs it can see in source; this
+rule catches what actually reached the program — including callbacks
+introduced through helper layers or decorators the per-module
+reachability cannot follow.
+""",
+)(_ir_only)
+
+
+rule(
+    "KB403",
+    "oversized constant baked into a traced program",
+    """
+A closure-captured constant above the per-entry byte threshold embedded in
+the compiled program instead of passed as an argument. Baked-in constants
+are copied into every compiled executable (once per jit cache entry — a
+leap program cached per power-of-two span length would hold one copy
+EACH), are re-hashed on every cache lookup, and silently pin stale data if
+the captured array should have been an input. Small lookup tables (the
+256-word crc32 table) are fine; a whole [N, N] mesh tensor is not — thread
+it through the function signature so it lives in one donated buffer.
+
+The registry traces at toy N, so the threshold is sized to catch
+state-shaped captures at trace scale, not only production scale.
+""",
+)(_ir_only)
+
+
+rule(
+    "KB404",
+    "hand-rolled or missing GSPMD sharding constraint",
+    """
+In the sharded twins (parallel/mesh.py, fleet/sharding.py, the sharded
+warp leap), every `sharding_constraint` in the traced program must carry a
+PartitionSpec derived from `parallel.state_specs` — row axis on 'peers',
+ensemble axis on 'ensemble', control scalars replicated. A hand-rolled
+spec (e.g. column-sharded state) silently forces GSPMD to insert resharding
+collectives on every tick; a sharded entry point with NO constraints at
+all has lost its layout pinning entirely, and the scan-carry placement
+drifts wherever XLA's cost model wanders (the exact failure
+`constrain_state` exists to prevent). `state_specs` is the single source
+of truth; derive from it, never restate it.
+""",
+)(_ir_only)
+
+
+rule(
+    "KB405",
+    "compile-surface budget exceeded (recompilation debt gate)",
+    """
+Memoization-based simulators get their speed from a small, stable set of
+compiled programs (PAPERS.md: memoization + fast-forwarding): the dense
+tick is ONE program rolled under scan, warp spans leap through
+O(log max_span) power-of-two programs, a fleet of thousands shares ONE
+vmapped program. This rule runs the scripted dense+warp+fleet exercise
+(analysis/ir/surface.py) in a fresh process, counts the XLA compilations
+each entry point triggers, and compares against the committed
+`.graftscan_surface.json`.
+
+A count above the committed budget is a recompilation regression — a
+spurious static argument, a shape that varies per call, a span-chunking
+policy that degraded to one program per span length — and fails the gate.
+Raising a committed count requires editing the budget file WITH a
+justification entry (CI rejects growth without one); under
+`--no-baseline-growth` a count *below* the budget also fails until the
+smaller number is committed, so the surface file is a shrink-only record
+of exactly how many programs the hot paths are allowed to cost.
+
+Unlike every other rule, KB405 findings are NOT baselineable in
+`.graftscan_baseline.json`: the justified surface file is the one and
+only accepted record, so growth can never be waved through sideways.
+""",
+)(_ir_only)

@@ -63,14 +63,18 @@ def _kernel_idv(state_ref, idv_ref, fp_ref, cnt_ref):
     pid = jax.lax.broadcasted_iota(jnp.uint32, idv_ref.shape, 1)
     h = peer_record_hash(pid, idv_ref[:])
     fp_ref[:] = _masked_wrap_sum(member, h)
-    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
+    # dtype spelled: integer sums promote to the platform int under
+    # jax_enable_x64 and the output ref is pinned int32 (graftscan KB401).
+    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32)
 
 
 def _kernel_hash(state_ref, hash_ref, fp_ref, cnt_ref):
     member = state_ref[:].astype(jnp.int32) > 0
     h = jnp.broadcast_to(hash_ref[:], member.shape)
     fp_ref[:] = _masked_wrap_sum(member, h)
-    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True)
+    # dtype spelled: integer sums promote to the platform int under
+    # jax_enable_x64 and the output ref is pinned int32 (graftscan KB401).
+    cnt_ref[:] = jnp.sum(member.astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32)
 
 
 def _block_rows(n: int, bytes_per_cell: int) -> int:

@@ -45,7 +45,12 @@ def _make_kernel(n: int):
         col = jax.lax.broadcasted_iota(jnp.int32, (bn, n), 1)
         row = base + jax.lax.broadcasted_iota(jnp.int32, (bn, n), 0)
 
-        cnt_ref[:] = jnp.sum((S > 0).astype(jnp.int32), axis=1, keepdims=True)
+        # dtype spelled on the sum: integer sums promote to the platform int
+        # under jax_enable_x64, and the kernel's output ref is pinned int32
+        # (graftscan KB401 — the x64 trace snaps on the mismatch).
+        cnt_ref[:] = jnp.sum(
+            (S > 0).astype(jnp.int32), axis=1, keepdims=True, dtype=jnp.int32
+        )
 
         NMAX = jnp.int32(jnp.iinfo(jnp.int32).max)
         timed = alive & (S == WAITING_FOR_PING) & (T <= thr)

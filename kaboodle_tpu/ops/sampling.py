@@ -137,7 +137,12 @@ def choose_among_candidates(  # graftlint: traced
     int32 ``[N]``, -1 where a row has no valid candidate."""
     if deterministic:
         return pick_candidate(idx, valid, None)
-    return pick_candidate(idx, valid, jax.random.uniform(key, (idx.shape[0],)))
+    # dtype pinned: the x32 default, spelled so the draw stays f32 under
+    # jax_enable_x64 (graftscan KB401 — an f64 draw here would also break
+    # draw parity with the warp leap's batched uniforms).
+    return pick_candidate(
+        idx, valid, jax.random.uniform(key, (idx.shape[0],), dtype=jnp.float32)
+    )
 
 
 def pick_candidate(  # graftlint: traced
@@ -207,7 +212,7 @@ def bernoulli_matrix(  # graftlint: traced
     """
     if deterministic:
         return jnp.broadcast_to(prob > 0, shape)
-    u = jax.random.uniform(key, shape)
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)  # pinned (KB401)
     return u < jnp.broadcast_to(prob, shape)
 
 

@@ -242,7 +242,8 @@ def make_chunked_tick_fn(
                 def _drop_rows(s0):
                     bi = s0 // block
                     u = jax.random.uniform(
-                        jax.random.fold_in(key_drop, bi), (block, n))
+                        jax.random.fold_in(key_drop, bi), (block, n),
+                        dtype=jnp.float32)
                     return u >= inp.drop_rate
 
                 # Gate the per-block uniform draws on the (traced) rate, as

@@ -212,7 +212,11 @@ def make_tick_fn(
                 # churn/partition-only scenarios skip the RNG entirely.
                 ok = jax.lax.cond(
                     inp.drop_rate > 0,
-                    lambda ok: ok & (jax.random.uniform(key_drop, (n, n)) >= inp.drop_rate),
+                    lambda ok: ok
+                    & (
+                        jax.random.uniform(key_drop, (n, n), dtype=jnp.float32)
+                        >= inp.drop_rate
+                    ),
                     lambda ok: ok,
                     ok,
                 )

@@ -177,7 +177,14 @@ def make_leap_fn(
         if det:
             xs = (ticks, jnp.zeros((k, 1), dtype=jnp.float32))  # u unused
         else:
-            xs = (ticks, jax.vmap(lambda kp: jax.random.uniform(kp, (n,)))(ping_keys))
+            # dtype pinned f32 (KB401): must match the dense kernel's
+            # pick_candidate uniforms bit-for-bit under any x64 flag state.
+            xs = (
+                ticks,
+                jax.vmap(
+                    lambda kp: jax.random.uniform(kp, (n,), dtype=jnp.float32)
+                )(ping_keys),
+            )
 
         seg = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W] within-segment
 

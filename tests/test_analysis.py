@@ -426,6 +426,108 @@ def test_registry_docs_complete():
         assert r.title and len(r.explain) > 40
 
 
+def test_kb302_oracle_and_fleet_stats_in_scope():
+    """oracle/ and fleet/stats.py are registered hot-path scope: the
+    dtype-discipline rule fires there (the parity oracles define what
+    'bit-exact' means — a dtype drift silently re-defines it)."""
+    src = "import jax.numpy as jnp\nx = jnp.zeros((4, 4))\n"
+    for path in (
+        "kaboodle_tpu/oracle/fingerprint.py",
+        "kaboodle_tpu/oracle/engine.py",
+        "kaboodle_tpu/oracle/lockstep.py",
+        "kaboodle_tpu/fleet/stats.py",
+    ):
+        assert "KB302" in rules_of(src, path), path
+    # analysis/core.py (outside HOT_DIRS) must not collide with fleet/core.py
+    assert "KB302" not in rules_of(src, "kaboodle_tpu/analysis/core.py")
+
+
+def test_pragma_on_nested_closure():
+    """The make_tick_fn idiom the graftscan registry depends on: the
+    pragma sits on a def NESTED inside an untraced factory, and tracing
+    (plus full-param taint) applies to that closure alone."""
+    src = """
+    def make_tick(cfg):
+        scale = cfg.scale
+        def tick(st, inp):  # graftlint: traced
+            if st > 0:
+                return st * scale
+            return inp
+        return tick
+    """
+    found = [f for f in analyze_source(textwrap.dedent(src)) if f.rule == "KB201"]
+    assert len(found) == 1 and "tick" in found[0].symbol
+    # the factory itself stays untraced: a branch there is host control flow
+    src_factory_branch = """
+    def make_tick(cfg):
+        if cfg.fast:
+            def tick(st):  # graftlint: traced
+                return st
+            return tick
+        return None
+    """
+    assert "KB201" not in rules_of(src_factory_branch)
+
+
+def test_pragma_on_decorated_function():
+    """Decorators stack ABOVE the def line; the pragma lives on the def
+    itself (node.lineno points at `def` since py3.8) and must still seed
+    tracing through arbitrary non-trace decorators."""
+    src = """
+    import functools
+
+    def wraps(f):
+        return f
+
+    @functools.lru_cache(maxsize=None)
+    @wraps
+    def tick(st, inp):  # graftlint: traced
+        if inp > 0:
+            return st
+        return inp
+    """
+    assert "KB201" in rules_of(src)
+    # ...and a pragma on the DECORATOR line must NOT seed (it is not the
+    # def line — the documented contract)
+    src_wrong_line = """
+    import functools
+
+    @functools.lru_cache(maxsize=None)  # graftlint: traced
+    def tick(st, inp):
+        if inp > 0:
+            return st
+        return inp
+    """
+    assert "KB201" not in rules_of(src_wrong_line)
+
+
+def test_pragma_closure_propagates_to_nested_defs():
+    """Defs nested inside a pragma'd function are traced transitively
+    (they run under the same trace), with their own full params."""
+    src = """
+    def leap(st):  # graftlint: traced
+        def body(carry, x):
+            if x > 0:
+                return carry, x
+            return carry, -x
+        return body(st, st)
+    """
+    found = [f for f in analyze_source(textwrap.dedent(src)) if f.rule == "KB201"]
+    assert len(found) == 1 and "body" in found[0].symbol
+
+
+def test_pragma_async_def_and_trailing_comment():
+    """AsyncFunctionDef collection + pragma coexisting with other trailing
+    comment text on the def line."""
+    src = """
+    async def tick(st):  # worker loop  # graftlint: traced
+        if st > 0:
+            return st
+        return -st
+    """
+    assert "KB201" in rules_of(src)
+
+
 def test_repo_is_clean_under_baseline(monkeypatch):
     """The acceptance gate: HEAD lints clean over the full default target
     set (baselined findings allowed, baseline not stale). Catches

@@ -168,6 +168,78 @@ def test_random_sparse_schedule_warp_arm(seed):
         )
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_recompile_counter_zero_after_warmup(seed):
+    """The graftscan KB405 property as a fuzz arm: a 64-tick randomized
+    dense+warp run triggers ZERO fresh XLA compilations once warmed.
+
+    Warm-up executes the randomized schedule once (dense tick-by-tick AND
+    through the warp runner — compiling the tick program, the quiescence/
+    convergence predicates, and every power-of-two leap chunk the spans
+    decompose into). The measured pass then re-dispatches the same
+    schedule — the dense arm from a DIFFERENT initial state (same shapes:
+    the tick program must be shape-stable across data) — under the
+    compile counter from analysis/ir/surface.py. Any fresh compile is a
+    memoization regression: a shape that varies per call, a static arg
+    leaking per-tick values, a leap-chunk policy that stopped caching."""
+    import jax
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs
+    from kaboodle_tpu.warp.runner import simulate_warped
+
+    assert_counter_live()  # a dead event stream would pass this vacuously
+
+    rng = np.random.default_rng(5000 + seed)
+    n = int(rng.integers(12, 20))
+    ticks = 64
+    cfg = SwimConfig(deterministic=bool(rng.integers(2)))
+    st = init_state(n, seed=seed, ring_contacts=n - 1, announced=True)
+
+    # Sparse randomized faults (quiescent spans exist for the leap).
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    manual = np.full((ticks, n), -1, dtype=np.int32)
+    for t in sorted(rng.choice(ticks, size=3, replace=False)):
+        if rng.integers(2):
+            kill[t, rng.integers(n)] = True
+        else:
+            manual[t, rng.integers(n)] = int(rng.integers(n))
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=idle.revive,
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=jnp.asarray(manual),
+        drop_ok=None,
+    )
+
+    # --- warm-up: one full execution of both arms -------------------------
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
+    sd = st
+    for t in range(ticks):
+        sd, _ = tick_fn(sd, jax.tree.map(lambda x: x[t], inputs))
+    simulate_warped(st, inputs, cfg, faulty=True, recheck_every=8)
+
+    # A different-data state for the measured dense arm (same shapes).
+    st_b = init_state(n, seed=seed + 17, ring_contacts=n - 1, announced=True)
+
+    # --- measured pass: zero fresh compiles -------------------------------
+    with compile_counter() as box:
+        sb = st_b
+        for t in range(ticks):
+            sb, _ = tick_fn(sb, jax.tree.map(lambda x: x[t], inputs))
+        simulate_warped(st, inputs, cfg, faulty=True, recheck_every=8)
+    assert box.count == 0, (
+        f"{box.count} fresh compiles in a warmed 64-tick dense+warp run "
+        f"(seed {seed}) — a recompilation regression"
+    )
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_random_scenario_chunked_third_engine(seed):
     """The chunked (row-blocked) kernel as a third arm of the same fuzz:

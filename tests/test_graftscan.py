@@ -1,0 +1,477 @@
+"""graftscan (kaboodle_tpu.analysis.ir) — passes, surface gate, mutations.
+
+The acceptance contract for the IR lane is mutation-tested: each seeded
+regression the ISSUE names — an injected f64 cast, a host callback inside
+the tick, a spurious static argument that multiplies the compile surface —
+must turn the gate red, and the corresponding clean twin must pass. The
+passes run on REAL kernel programs (the dense tick, the warp leap) traced
+at toy scale, plus small synthetic jaxprs for the pass-specific corners;
+the committed `.graftscan_surface.json` numbers themselves are only
+asserted by the fresh-process CLI gate (`make lint` / CI), never
+in-process (earlier tests warm eager caches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.analysis.core import BaselineError
+from kaboodle_tpu.analysis.ir import scan as ir_scan
+from kaboodle_tpu.analysis.ir import surface as ir_surface
+from kaboodle_tpu.analysis.ir.registry import (
+    ENTRY_POINTS,
+    EntryPoint,
+    select_entries,
+    trace_entry,
+)
+from kaboodle_tpu.analysis.ir.walk import terminal_consumers
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+N = 16  # trace scale for the mutation entries
+
+
+def _cfg():
+    return SwimConfig(deterministic=True)
+
+
+def _tick_entry(name: str, wrap=None, **entry_kw) -> EntryPoint:
+    """An EntryPoint over the real fault-free dense tick, optionally with a
+    mutation ``wrap(tick) -> tick`` applied."""
+
+    def build():
+        tick = make_tick_fn(_cfg(), faulty=False)
+        fn = wrap(tick) if wrap is not None else tick
+        return fn, (init_state(N, seed=0), idle_inputs(N))
+
+    return EntryPoint(name, build, **entry_kw)
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_registry_names_unique_and_selectable():
+    names = [e.name for e in ENTRY_POINTS]
+    assert len(names) == len(set(names))
+    assert select_entries(None) == ENTRY_POINTS
+    assert [e.name for e in select_entries(["ops.crc32"])] == ["ops.crc32"]
+    with pytest.raises(KeyError):
+        select_entries(["no.such.entry"])
+
+
+def test_cheap_entries_trace_both_modes():
+    entry = select_entries(["ops.crc32"])[0]
+    assert trace_entry(entry, x64=False).jaxpr.eqns
+    assert trace_entry(entry, x64=True).jaxpr.eqns
+
+
+# ---------------------------------------------------------------------------
+# KB401 — the seeded f64-cast mutation
+
+
+def test_clean_tick_has_no_findings():
+    findings = ir_scan.scan_entry(_tick_entry("clean.tick"))
+    assert findings == []
+
+
+@pytest.mark.filterwarnings("ignore:Explicitly requested dtype")
+def test_mutation_f64_cast_turns_kb401_red():
+    """The ISSUE's seeded regression #1: an injected f64 cast of an [N, N]
+    resident. Invisible under x32 (the cast silently lands on f32, with
+    the warning this test expects); the x64 trace makes it real and KB401
+    fires."""
+
+    def wrap(tick):
+        def mutated(st, inp):
+            st2, m = tick(st, inp)
+            wide = st2.timer.astype(jnp.float64)  # the seeded widening
+            return dataclasses.replace(
+                st2, timer=wide.astype(st2.timer.dtype)
+            ), m
+
+        return mutated
+
+    findings = ir_scan.scan_entry(_tick_entry("mut.f64", wrap))
+    assert "KB401" in rules_of(findings)
+    assert any("float64" in f.message or "x64" in f.message for f in findings)
+
+
+def test_kb401_lean_widening_detector():
+    """int16 widened into a write (select_n) fires; widening that only
+    feeds the age-arithmetic allowlist (sub/compares) does not."""
+
+    def bad_build():
+        def f(t16):
+            w = t16.astype(jnp.int32)
+            return jnp.where(w > 0, w, 0)  # widened value written
+
+        return f, (jnp.zeros((8, 8), jnp.int16),)
+
+    def good_build():
+        def f(t16, t):
+            age = t - t16.astype(jnp.int32)  # the kernel's age idiom
+            return age >= 2
+
+        return f, (jnp.zeros((8, 8), jnp.int16), jnp.int32(5))
+
+    bad = ir_scan.scan_entry(EntryPoint("mut.lean", bad_build, lean=True))
+    assert "KB401" in rules_of(bad)
+    good = ir_scan.scan_entry(EntryPoint("ok.lean", good_build, lean=True))
+    assert "KB401" not in rules_of(good)
+    # the same program is exempt when the entry is not lean-flagged
+    notlean = ir_scan.scan_entry(EntryPoint("ok.fat", bad_build))
+    assert "KB401" not in rules_of(notlean)
+
+
+def test_real_lean_tick_widenings_are_allowlisted():
+    """The production lean tick's only int16 widenings are the documented
+    age computations — the detector must stay quiet on them."""
+    entry = select_entries(["sim.tick.dense.lean"])[0]
+    from kaboodle_tpu.analysis.ir.passes import check_kb401_lean_widening
+
+    assert check_kb401_lean_widening(entry, trace_entry(entry)) == []
+
+
+# ---------------------------------------------------------------------------
+# KB402 — the seeded host-callback mutation
+
+
+def test_mutation_host_callback_turns_kb402_red():
+    """The ISSUE's seeded regression #2: a debug callback inside the tick
+    (one device->host round trip per scanned tick)."""
+
+    def wrap(tick):
+        def mutated(st, inp):
+            st2, m = tick(st, inp)
+            jax.debug.print("tick {t}", t=st2.tick)
+            return st2, m
+
+        return mutated
+
+    findings = ir_scan.scan_entry(_tick_entry("mut.callback", wrap))
+    assert "KB402" in rules_of(findings)
+
+
+def test_clean_tick_has_no_kb402():
+    entry = select_entries(["sim.tick.dense.faulty"])[0]
+    from kaboodle_tpu.analysis.ir.passes import check_kb402_host_boundary
+
+    assert check_kb402_host_boundary(entry, trace_entry(entry)) == []
+
+
+# ---------------------------------------------------------------------------
+# KB403 — oversized captured constants
+
+
+def test_kb403_flags_big_capture_not_small():
+    big = jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64)  # 16 KiB
+    small = jnp.arange(64, dtype=jnp.float32)  # 256 B
+
+    def big_build():
+        return (lambda x: x + big), (jnp.zeros((64, 64), jnp.float32),)
+
+    def small_build():
+        return (lambda x: x + small), (jnp.zeros((64,), jnp.float32),)
+
+    bad = ir_scan.scan_entry(EntryPoint("mut.const", big_build))
+    assert "KB403" in rules_of(bad)
+    ok = ir_scan.scan_entry(EntryPoint("ok.const", small_build))
+    assert "KB403" not in rules_of(ok)
+
+
+# ---------------------------------------------------------------------------
+# KB404 — sharding-spec derivation
+
+
+def _mesh():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), ("peers",))
+
+
+def test_kb404_hand_rolled_spec_flagged():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh()
+
+    def bad_build():
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x * 2, NamedSharding(mesh, P(None, "peers"))  # column-sharded!
+            )
+
+        return f, (jnp.zeros((8, 8), jnp.float32),)
+
+    findings = ir_scan.scan_entry(EntryPoint("mut.spec", bad_build, sharded=True))
+    assert "KB404" in rules_of(findings)
+
+
+def test_kb404_derived_spec_and_missing_constraints():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh()
+
+    def good_build():
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x * 2, NamedSharding(mesh, P("peers", None))
+            )
+
+        return f, (jnp.zeros((8, 8), jnp.float32),)
+
+    def bare_build():
+        return (lambda x: x * 2), (jnp.zeros((8, 8), jnp.float32),)
+
+    good = ir_scan.scan_entry(EntryPoint("ok.spec", good_build, sharded=True))
+    assert "KB404" not in rules_of(good)
+    # ... and an unsharded entry is out of scope entirely
+    assert "KB404" not in rules_of(
+        ir_scan.scan_entry(EntryPoint("ok.unsharded", bare_build))
+    )
+    # a sharded program with NO constraints lost its layout pinning
+    missing = ir_scan.scan_entry(EntryPoint("mut.bare", bare_build, sharded=True))
+    assert any(
+        f.rule == "KB404" and f.symbol == "missing-constraints" for f in missing
+    )
+
+
+def test_real_sharded_entries_pass_kb404():
+    from kaboodle_tpu.analysis.ir.passes import check_kb404_sharding_specs
+
+    for name in ("parallel.tick.sharded", "warp.leap.sharded"):
+        entry = select_entries([name])[0]
+        assert check_kb404_sharding_specs(entry, trace_entry(entry)) == []
+
+
+# ---------------------------------------------------------------------------
+# KB405 — the compile-surface budget
+
+
+def test_compile_counter_counts_fresh_compiles_only():
+    f = jax.jit(lambda x: x * 3 + 1)
+    a, b = jnp.zeros(7), jnp.ones(7)  # prepped outside (eager fills compile)
+    with ir_surface.compile_counter() as box:
+        f(a)
+        f(b)  # cache hit
+    assert box.count == 1
+    with ir_surface.compile_counter() as box2:
+        f(a)  # still cached
+    assert box2.count == 0
+
+
+def test_mutation_spurious_static_arg_doubles_surface():
+    """The ISSUE's seeded regression #3: the dense tick dispatched through
+    a jit with a spurious static argument — every input variant then
+    compiles its own program, and the measured count exceeds the committed
+    budget, turning KB405 red."""
+    from kaboodle_tpu.analysis.ir.surface import (
+        SurfaceExercise,
+        _prep_dense,
+        measure_surface,
+        surface_findings,
+    )
+
+    def prep():
+        ctx = _prep_dense()
+        raw = make_tick_fn(_cfg(), faulty=True)
+        # the mutation: a call counter passed static — one program per call
+        ctx["tick_mut"] = jax.jit(
+            lambda st, inp, i: raw(st, inp), static_argnums=2
+        )
+        return ctx
+
+    def run(ctx):
+        st = ctx["st"]
+        for i, inp in enumerate(ctx["variants"]):
+            st, _ = ctx["tick_mut"](st, inp, i)
+
+    measured = measure_surface([SurfaceExercise("dense", prep, run)])
+    assert measured["dense"] >= 5  # one program per static-arg value
+    committed = {"dense": (3, "the three tick programs")}
+    findings = surface_findings(measured, committed)
+    assert any(f.rule == "KB405" and "grew" in f.message for f in findings)
+
+
+def test_surface_findings_gate_semantics():
+    committed = {"a": (3, "ok"), "b": (2, "ok"), "gone": (1, "ok")}
+    # growth always fails; shrink/orphan only under no-growth
+    grow = ir_surface.surface_findings({"a": 4, "b": 2}, committed)
+    assert [f.symbol for f in grow] == ["surface:a:growth"]
+    clean = ir_surface.surface_findings({"a": 3, "b": 2}, committed)
+    assert clean == []
+    strict = ir_surface.surface_findings(
+        {"a": 2, "b": 2}, committed, no_growth=True
+    )
+    assert {f.symbol for f in strict} == {
+        "surface:a:stale",
+        "surface:gone:orphan",
+    }
+    missing = ir_surface.surface_findings({"new": 1}, {})
+    assert [f.symbol for f in missing] == ["surface:new:missing"]
+
+
+def test_surface_file_roundtrip(tmp_path):
+    p = tmp_path / "surface.json"
+    assert ir_surface.load_surface(p) == {}
+    ir_surface.write_surface(p, {"dense": 3}, {"dense": (9, "old reason")})
+    loaded = ir_surface.load_surface(p)
+    assert loaded == {"dense": (3, "old reason")}
+    p.write_text(json.dumps({"entries": [{"entry": "x", "programs": 1}]}))
+    with pytest.raises(BaselineError):
+        ir_surface.load_surface(p)  # justification missing
+    p.write_text("not json")
+    with pytest.raises(BaselineError):
+        ir_surface.load_surface(p)
+
+
+# ---------------------------------------------------------------------------
+# walk helpers
+
+
+def test_terminal_consumers_resolve_through_transparent_ops():
+    """A value consumed through broadcast/reshape resolves to the real
+    computing primitives, and escaping a scope reports the sentinel."""
+    from kaboodle_tpu.analysis.ir.walk import iter_jaxprs
+
+    def f(t16):
+        w = t16.astype(jnp.int32)
+        wide = jnp.broadcast_to(w[None], (2, *w.shape))
+        return wide > 0
+
+    cj = jax.make_jaxpr(f)(jnp.zeros((4, 4), jnp.int16))
+    consumer_sets = []
+    for j in iter_jaxprs(cj.jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                consumer_sets.append(terminal_consumers(j, eqn.outvars[0]))
+    assert consumer_sets, "expected an int16->int32 convert in the trace"
+    flat = set().union(*consumer_sets)
+    # the broadcast is traversed, pjit bodies are entered: the terminal
+    # consumer is the comparison (or the escape sentinel at scope edges)
+    assert "gt" in flat
+    assert "broadcast_in_dim" not in flat
+
+    def g(t16):
+        return t16.astype(jnp.int32)  # escapes as the jaxpr output
+
+    cjg = jax.make_jaxpr(g)(jnp.zeros((4,), jnp.int16))
+    escaped = set()
+    for j in iter_jaxprs(cjg.jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                escaped |= terminal_consumers(j, eqn.outvars[0])
+    assert "<jaxpr-output>" in escaped
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring (canned scan — the real full gate is `make lint` / CI)
+
+
+def test_cli_explain_and_unknown_entry():
+    from kaboodle_tpu.analysis.cli import main
+
+    assert main(["--explain", "KB401"]) == 0
+    assert main(["--explain", "KB405"]) == 0
+    assert main(["--ir", "--entries", "bogus.entry", "--no-surface"]) == 2
+
+
+def test_cli_gate_goes_red_on_mutated_registry(monkeypatch):
+    """End-to-end through the exact entry `make lint` uses: with a mutated
+    entry point in the registry, `python -m kaboodle_tpu.analysis --ir`
+    exits 1; the unmutated registry entry exits 0."""
+    from kaboodle_tpu.analysis import cli
+    from kaboodle_tpu.analysis.ir import registry
+
+    def wrap(tick):
+        def mutated(st, inp):
+            st2, m = tick(st, inp)
+            jax.debug.print("t={t}", t=st2.tick)
+            return st2, m
+
+        return mutated
+
+    monkeypatch.setattr(
+        registry, "ENTRY_POINTS", (_tick_entry("mut.cli.tick", wrap),)
+    )
+    assert cli.main(["--ir", "--no-surface", "--no-baseline"]) == 1
+    monkeypatch.setattr(
+        registry, "ENTRY_POINTS", (_tick_entry("ok.cli.tick"),)
+    )
+    assert cli.main(["--ir", "--no-surface", "--no-baseline"]) == 0
+
+
+def test_cli_ir_baseline_filtering(tmp_path, monkeypatch, capsys):
+    """IR findings flow through the shared baseline plumbing: unbaselined
+    findings fail, justified ones pass, stale entries fail under
+    --no-baseline-growth."""
+    from kaboodle_tpu.analysis import cli
+    from kaboodle_tpu.analysis.core import Finding
+
+    canned = [
+        Finding("ir://toy", "KB402", 0, "host boundary 'io_callback'", "t.py:io_callback")
+    ]
+
+    def fake_run_scan(entry_names=None, entries=None, with_surface=True, progress=None):
+        return ir_scan.ScanResult(list(canned), {}, 1)
+
+    monkeypatch.setattr(ir_scan, "run_scan", fake_run_scan)
+    base = tmp_path / "base.json"
+    args = ["--ir", "--no-surface", "--baseline", str(base)]
+    assert cli.main(args) == 1  # unbaselined finding
+
+    base.write_text(
+        json.dumps(
+            {"entries": [{"key": canned[0].key, "reason": "known debt"}]}
+        )
+    )
+    assert cli.main(args) == 0  # justified
+
+    canned.clear()
+    assert cli.main(args) == 0  # stale entry tolerated without no-growth
+    assert cli.main(args + ["--no-baseline-growth"]) == 1  # ...but not with
+
+
+def test_cli_ir_rejects_positional_paths():
+    from kaboodle_tpu.analysis.cli import main
+
+    assert main(["--ir", "kaboodle_tpu/warp", "--no-surface"]) == 2
+
+
+def test_kb405_findings_are_not_baselineable(tmp_path, monkeypatch):
+    """A .graftscan_baseline.json entry keyed at a surface-growth finding
+    must NOT suppress it — the justified surface file is the only accepted
+    record of the compile surface."""
+    from kaboodle_tpu.analysis import cli
+
+    def fake_run_scan(entry_names=None, entries=None, with_surface=True, progress=None):
+        return ir_scan.ScanResult([], {"warp": 99}, 1)
+
+    monkeypatch.setattr(ir_scan, "run_scan", fake_run_scan)
+    surface = tmp_path / "surface.json"
+    ir_surface.write_surface(surface, {"warp": 1}, {"warp": (1, "one program")})
+    [growth] = ir_surface.surface_findings({"warp": 99}, {"warp": (1, "x")})
+    base = tmp_path / "base.json"
+    base.write_text(
+        json.dumps({"entries": [{"key": growth.key, "reason": "nope"}]})
+    )
+    rc = cli.main(
+        ["--ir", "--surface", str(surface), "--baseline", str(base)]
+    )
+    assert rc == 1  # growth still red despite the baseline entry
+
+
+def test_assert_counter_live_passes_here():
+    ir_surface.assert_counter_live()  # this environment's stream is live

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun telemetry-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -60,6 +60,7 @@ ci: lint native test
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 	$(MAKE) fleet-dryrun
 	$(MAKE) warp-dryrun
+	$(MAKE) telemetry-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -74,6 +75,21 @@ fleet-dryrun:
 # (PERF.md "Warp"); CI only proves the lane runs end-to-end.
 warp-dryrun:
 	timeout 300 $(PYTHON) bench.py --warp --platform cpu --n 256 --ticks 64
+
+# Telemetry dryrun (kaboodle_tpu/telemetry) at toy scale: a dense run and a
+# warped run each write a JSONL manifest (counters + flight-recorder dump),
+# then the summarizer schema-gates BOTH files (--check fails on any invalid
+# record or an empty manifest) and exports a Chrome-trace/Perfetto JSON.
+# Proves the whole export plane — telemetry kernel build -> manifest ->
+# summarizer -> trace — end to end in one target.
+telemetry-dryrun:
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu \
+	  --sim 32 --ticks 16 --telemetry /tmp/kaboodle-telemetry-dryrun.jsonl
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu \
+	  --sim 32 --ticks 48 --warp --telemetry /tmp/kaboodle-telemetry-dryrun-warp.jsonl
+	$(PYTHON) -m kaboodle_tpu telemetry --check \
+	  --trace /tmp/kaboodle-telemetry-dryrun.trace.json \
+	  /tmp/kaboodle-telemetry-dryrun.jsonl /tmp/kaboodle-telemetry-dryrun-warp.jsonl
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

@@ -320,6 +320,92 @@ def _bench_warp(n: int, ticks: int):
     }
 
 
+def _bench_telemetry_ab(n: int, ticks: int):
+    """A/B: the telemetry-plane tick vs the plain tick on the steady lane.
+
+    Same faulty-build scan, same converged steady-state scenario as the
+    warp A/B's dense arm (two sparse manual pings over ``ticks`` ticks) —
+    arm A runs today's ``simulate``, arm B ``simulate_with_telemetry``
+    (per-tick ProtocolCounters + a 32-slot flight recorder carried through
+    the scan). Both are AOT-compiled, warmed once, and timed as the best
+    of three executions per arm (compile excluded; a single-digit-percent
+    delta is the size of single-run scheduler noise on the CPU lane), and
+    the final states are compared bit-for-bit first: telemetry is a
+    pure-added-outputs contract, so any state difference voids the
+    measurement. The acceptance bar is overhead
+    <= 5% on the bench lane (ISSUE 6 / PERF.md "Telemetry").
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.runner import simulate, simulate_with_telemetry
+    from kaboodle_tpu.sim.scenario import Scenario
+    from kaboodle_tpu.sim.state import init_state
+
+    cfg = SwimConfig()
+    lean = n >= LEAN_STATE_MIN_N
+    narrow = lean and ticks <= 32000
+    st = init_state(n, seed=0, ring_contacts=n - 1, announced=True,
+                    track_latency=not lean, instant_identity=lean,
+                    timer_dtype=jnp.int16 if narrow else jnp.int32)
+    sc = Scenario(n, ticks, seed=0)
+    sc.manual_ping_at(ticks // 3, 0, 1)
+    sc.manual_ping_at((2 * ticks) // 3, 1, 2)
+    inputs = sc.build()
+    rtt = _null_rtt()
+
+    plain = jax.jit(
+        lambda s, i: simulate(s, i, cfg, faulty=True)
+    ).lower(st, inputs).compile()
+    telem = jax.jit(
+        lambda s, i: simulate_with_telemetry(s, i, cfg, faulty=True,
+                                             recorder_len=32)
+    ).lower(st, inputs).compile()
+
+    # Warm both arms once (first execution of an AOT program still pays
+    # one-time buffer/donation setup on some backends), then take the best
+    # of three timed executions per arm — a single-digit-percent delta is
+    # exactly the size of single-run scheduler noise on the CPU lane.
+    out_a = plain(st, inputs)
+    jax.block_until_ready(out_a)
+    out_b = telem(st, inputs)
+    jax.block_until_ready(out_b)
+
+    def best_of(fn, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(st, inputs))
+            best = min(best, time.perf_counter() - t0 - rtt)
+        return max(best, 1e-9)
+
+    off_wall = best_of(plain)
+    on_wall = best_of(telem)
+
+    def _leaf_equal(a, b):
+        av, bv = np.asarray(a), np.asarray(b)
+        if np.issubdtype(av.dtype, np.floating):  # latency plane carries NaNs
+            return bool(((av == bv) | (np.isnan(av) & np.isnan(bv))).all())
+        return bool((av == bv).all())
+
+    bit_exact = all(
+        _leaf_equal(a, b)
+        for a, b in zip(jax.tree.leaves(out_a[0]), jax.tree.leaves(out_b[0]))
+    )
+    return {
+        "n": n,
+        "ticks": ticks,
+        "telemetry_off_wall_s": round(off_wall, 4),
+        "telemetry_on_wall_s": round(on_wall, 4),
+        "overhead_pct": round(100.0 * (on_wall / off_wall - 1.0), 2),
+        "recorder_len": 32,
+        "bit_exact": bit_exact,
+        "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
+    }
+
+
 def _peak_device_memory_mib():
     """Peak device-memory use of the default device, if the backend reports
     it (TPU does; the CPU backend returns nothing)."""
@@ -634,11 +720,16 @@ def _accelerator_responsive(
     return False
 
 
-def _emit_benchdoc(line: dict) -> None:
+def _emit_benchdoc(line: dict, manifest: str | None = None) -> None:
     """The full-document half of the output contract (VERDICT r4 item 5):
     one ``BENCHDOC``-tagged line + a repo-side mirror file. Every lane ends
     with this followed by its own compact single-line JSON summary, so a
-    stdout-tail capture always parses the last line."""
+    stdout-tail capture always parses the last line.
+
+    ``manifest`` additionally appends the document as a schema-tagged
+    ``run`` record to a JSONL run manifest (kaboodle_tpu.telemetry.manifest)
+    — the shared machine-output schema of bench.py, the fleet sweep CLI,
+    and the sim CLI, so one summarizer reads any lane's output."""
     import os
 
     doc = json.dumps(line)
@@ -649,6 +740,17 @@ def _emit_benchdoc(line: dict) -> None:
             f.write(doc + "\n")
     except OSError as e:
         print(f"bench: could not write BENCH_last_full.json: {e}", file=sys.stderr)
+    if manifest:
+        from kaboodle_tpu.telemetry import ManifestWriter
+
+        try:
+            with ManifestWriter(manifest, append=True) as w:
+                w.write("run", **line)
+            print(f"bench: manifest record appended to {manifest}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"bench: could not write manifest {manifest}: {e}",
+                  file=sys.stderr)
 
 
 def _pin_cpu() -> None:
@@ -699,6 +801,16 @@ def main() -> None:
                    help="run the warp-vs-dense A/B (event-horizon fast-forward "
                         "on the sparse-fault steady-state scenario) instead of "
                         "the standard sections; same JSON tail contract")
+    p.add_argument("--telemetry-ab", action="store_true",
+                   help="run the telemetry-on-vs-off A/B (the kaboodle_tpu."
+                        "telemetry counter+recorder plane on the steady-state "
+                        "scan) instead of the standard sections; same JSON "
+                        "tail contract")
+    p.add_argument("--manifest", metavar="PATH", default=None,
+                   help="append the BENCHDOC line as a 'run' record to a "
+                        "JSONL telemetry manifest (kaboodle_tpu.telemetry."
+                        "manifest schema; summarize with `python -m "
+                        "kaboodle_tpu telemetry PATH`)")
     args = p.parse_args()
 
     if args.platform == "cpu":
@@ -741,7 +853,31 @@ def main() -> None:
             "peak_rss_mib": round(
                 resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
         }
-        _emit_benchdoc(line)
+        _emit_benchdoc(line, manifest=args.manifest)
+        print(json.dumps(line))  # compact == full for this single-section lane
+        return
+    if args.telemetry_ab:
+        # Focused telemetry A/B lane (ISSUE 6 acceptance: telemetry-on
+        # steady tick <= 5% slower than telemetry-off, PERF.md "Telemetry").
+        # Same output contract as the warp lane.
+        tn = args.n or (1024 if not on_tpu else 16384)
+        tt = 64 if args.ticks is None else args.ticks
+        ab = _bench_telemetry_ab(tn, tt)
+        line = {
+            "metric": "telemetry_overhead_pct",
+            "value": ab["overhead_pct"],
+            "unit": "%",
+            "n_peers": ab["n"],
+            "ticks": ab["ticks"],
+            "backend": backend + (" (fallback: accelerator unresponsive)"
+                                  if fallback else ""),
+            **{k: ab[k] for k in (
+                "telemetry_off_wall_s", "telemetry_on_wall_s",
+                "recorder_len", "bit_exact", "state_variant")},
+            "peak_rss_mib": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        }
+        _emit_benchdoc(line, manifest=args.manifest)
         print(json.dumps(line))  # compact == full for this single-section lane
         return
     # Single-chip ceiling: N=32,768 lean+int16 is 1 GiB state + 2 GiB timers
@@ -939,7 +1075,7 @@ def main() -> None:
     # process ENDS with one compact single-line JSON summary that always
     # parses from a tail capture. Readers that want detail follow the tag or
     # the file; machine consumers take the last line.
-    _emit_benchdoc(line)
+    _emit_benchdoc(line, manifest=args.manifest)
 
     def _sec(d, *keys):
         """Terse verdict from a section dict: just the named keys."""

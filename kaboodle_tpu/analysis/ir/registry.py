@@ -3,8 +3,9 @@
 One :class:`EntryPoint` per compiled-program family the simulator actually
 dispatches in production: the dense tick (faulty / fast-path / lean-int16 /
 random-draw variants), the chunked row-blocked twin, the warp leap scan,
-the vmapped fleet tick, the fused ops + crc32 primitives, and the
-GSPMD-sharded twins. Each entry knows how to build ``(fn, example_args)``
+the vmapped fleet tick, the fused ops + crc32 primitives, the
+GSPMD-sharded twins, and the telemetry-plane builds (dense / lean /
+chunked / fleet tick plus the flight-recorder scan body — ISSUE 6). Each entry knows how to build ``(fn, example_args)``
 at **toy trace scale** — tracing is abstract evaluation, so N=32 exercises
 the identical program structure the production N=65,536 program has, at
 AST-adjacent cost.
@@ -124,6 +125,70 @@ def _chunked():
 
     fn = make_chunked_tick_fn(_cfg(), faulty=True, block=TRACE_N // 2)
     return fn, (_full_state(), _idle())
+
+
+# -- telemetry-plane builds (ISSUE 6): the telemetry=True twins of the tick
+# programs. Same pass pipeline as their plain counterparts — in particular
+# KB402 proves the counter/recorder plane adds NO host callback, and the
+# lean entry proves the int16 discipline survives the added reductions.
+
+
+def _dense_telemetry():
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    return (
+        make_tick_fn(_cfg(), faulty=True, telemetry=True),
+        (_full_state(), _idle()),
+    )
+
+
+def _dense_telemetry_lean():
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    return (
+        make_tick_fn(_cfg(), faulty=False, telemetry=True),
+        (_lean_state(), _idle()),
+    )
+
+
+def _chunked_telemetry():
+    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+
+    fn = make_chunked_tick_fn(
+        _cfg(), faulty=True, block=TRACE_N // 2, telemetry=True
+    )
+    return fn, (_full_state(), _idle())
+
+
+def _fleet_telemetry():
+    from kaboodle_tpu.fleet.core import (
+        fleet_idle_inputs,
+        init_fleet,
+        make_fleet_tick_fn,
+    )
+
+    fleet = init_fleet(TRACE_N // 2, TRACE_E)
+    inputs = fleet_idle_inputs(TRACE_N // 2, TRACE_E)
+    return (
+        make_fleet_tick_fn(_cfg(), faulty=True, telemetry=True),
+        (fleet.mesh, inputs),
+    )
+
+
+def _recorder_scan_telemetry():
+    # The converged-run shape: telemetry tick + flight-recorder ring in ONE
+    # while_loop body — the program run_until_converged_telemetry dispatches.
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.telemetry.recorder import init_recorder, record_tick
+
+    tick = make_tick_fn(_cfg(), faulty=False, telemetry=True)
+    rec0 = init_recorder(8, TRACE_N)
+
+    def tick_and_record(st, inp, rec):
+        st, out = tick(st, inp)
+        return st, record_tick(rec, st.tick - 1, out)
+
+    return tick_and_record, (_full_state(), _idle(), rec0)
 
 
 def _warp_leap():
@@ -259,6 +324,11 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("sim.tick.dense.lean", _dense_lean, lean=True),
     EntryPoint("sim.tick.dense.random", _dense_random),
     EntryPoint("sim.tick.chunked", _chunked),
+    EntryPoint("sim.tick.dense.telemetry", _dense_telemetry),
+    EntryPoint("sim.tick.dense.telemetry.lean", _dense_telemetry_lean, lean=True),
+    EntryPoint("sim.tick.chunked.telemetry", _chunked_telemetry),
+    EntryPoint("fleet.tick.telemetry", _fleet_telemetry),
+    EntryPoint("sim.recorder.telemetry", _recorder_scan_telemetry),
     EntryPoint("warp.leap", _warp_leap),
     EntryPoint("warp.leap.lean", _warp_leap_lean, lean=True),
     EntryPoint("fleet.tick", _fleet_tick),

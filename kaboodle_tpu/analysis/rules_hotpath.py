@@ -33,6 +33,12 @@ HOT_DIRS = (
     # KB301 is reachability-scoped, so the oracle's intentional host numpy
     # (untraced code) does not fire.
     "kaboodle_tpu/oracle/",
+    # telemetry/: the counter pytree and flight-recorder ring ride INSIDE
+    # the jitted tick/scan programs (counters.py, recorder.py); a host sync
+    # or dtype drift there taxes every telemetry-enabled run and breaks the
+    # cross-engine counter-parity pins. The export half (manifest/trace/
+    # summary) is host-side by design — untraced, so KB301 stays quiet.
+    "kaboodle_tpu/telemetry/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
@@ -52,6 +58,11 @@ DTYPE_DISCIPLINE_FILES = (
     # oracle/: the reference-semantics twins whose fingerprints the parity
     # suites compare against the kernels' — wrong dtype = wrong oracle.
     "fingerprint.py", "engine.py", "lockstep.py",
+    # telemetry/: the on-device halves. ProtocolCounters leaves are pinned
+    # int32/uint32 (gossip_bytes REQUIRES modular uint32 wraparound), and
+    # the recorder ring's slots must hold the exact dtypes the counters
+    # carry or the dump re-defines what the parity fuzz compared.
+    "counters.py", "recorder.py",
 )
 
 _CONSTRUCTORS = {

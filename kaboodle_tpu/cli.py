@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the event-horizon leap engine (kaboodle_tpu.warp) — "
                         "bit-exact with dense ticking, dispatches only the "
                         "eventful/dense ticks")
+    p.add_argument("--telemetry", nargs="?", const="telemetry.jsonl",
+                   default=None, metavar="PATH",
+                   help="sim mode: run the telemetry-plane kernel build "
+                        "(kaboodle_tpu.telemetry — per-tick ProtocolCounters "
+                        "+ flight recorder) and write a JSONL run manifest "
+                        "(default: telemetry.jsonl); summarize with "
+                        "`python -m kaboodle_tpu telemetry PATH`")
+    p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
+                   help="sim mode: dump per-tick TickMetrics as manifest "
+                        "'tick' records to PATH (no telemetry build needed; "
+                        "same schema as --telemetry manifests)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -281,17 +292,28 @@ def run_sim(args) -> int:
     else:
         sc = Scenario(n=args.sim, ticks=args.ticks, seed=args.seed)
     state = init_state(sc.n, seed=args.seed, alive=jnp.asarray(sc.initial_alive()))
+    telemetry = args.telemetry is not None
     if args.warp:
         # Event-horizon fast-forward: only the dense ticks produce metrics
         # (leaped spans are provably converged/quiet), so the summary reports
-        # both counts plus end-state convergence (kaboodle_tpu.warp).
+        # both counts plus end-state convergence (kaboodle_tpu.warp). With
+        # --telemetry the leaped spans still contribute counter totals via
+        # the closed form (telemetry.counters.leap_counters).
         from kaboodle_tpu.sim.runner import state_converged
         from kaboodle_tpu.warp.runner import simulate_warped
 
         t0 = time.perf_counter()
-        final, dense_ticks, _m = simulate_warped(
-            state, sc.build(), SwimConfig(), faulty=True
-        )
+        if telemetry:
+            final, dense_ticks, stacked, totals = simulate_warped(
+                state, sc.build(), SwimConfig(), faulty=True, telemetry=True
+            )
+            m = stacked.metrics if stacked is not None else None
+            counters = stacked.counters if stacked is not None else None
+        else:
+            final, dense_ticks, m = simulate_warped(
+                state, sc.build(), SwimConfig(), faulty=True
+            )
+            counters = totals = None
         final_conv = bool(state_converged(final))
         wall = time.perf_counter() - t0
         out = {
@@ -303,10 +325,23 @@ def run_sim(args) -> int:
             "final_converged": final_conv,
             "wall_s": round(wall, 3),
         }
+        if totals is not None:
+            out["counter_totals"] = totals
+        _write_sim_manifests(args, out, m, counters, ticks=dense_ticks)
         print(json.dumps(out))
         return 0 if out["final_converged"] else 2
     t0 = time.perf_counter()
-    final, m = simulate(state, sc.build(), SwimConfig())
+    counters = recorder = None
+    if telemetry:
+        from kaboodle_tpu.sim.runner import simulate_with_telemetry
+        from kaboodle_tpu.telemetry import counters_totals
+
+        final, m, counters, recorder = simulate_with_telemetry(
+            state, sc.build(), SwimConfig(),
+            recorder_len=min(32, max(1, sc.ticks)),
+        )
+    else:
+        final, m = simulate(state, sc.build(), SwimConfig())
     conv = np.asarray(m.converged)
     wall = time.perf_counter() - t0
     first = int(np.argmax(conv)) if conv.any() else -1
@@ -319,8 +354,39 @@ def run_sim(args) -> int:
         "messages_delivered": int(np.asarray(m.messages_delivered).sum()),
         "wall_s": round(wall, 3),
     }
+    if counters is not None:
+        out["counter_totals"] = counters_totals(counters)
+    _write_sim_manifests(args, out, m, counters, recorder=recorder)
     print(json.dumps(out))
     return 0 if out["final_converged"] else 2
+
+
+def _write_sim_manifests(args, out, metrics, counters, ticks=None,
+                         recorder=None) -> None:
+    """The sim lane's manifest outputs (telemetry/manifest.py schema).
+
+    ``--telemetry PATH`` gets the full manifest: a ``run`` record (the same
+    summary dict the CLI prints), per-tick records with counters, and the
+    flight-recorder dump. ``--metrics-jsonl PATH`` gets metrics-only
+    ``tick`` records — the lightweight lane that needs no telemetry build.
+    Both may be given; they are independent files.
+    """
+    if args.metrics_jsonl is None and args.telemetry is None:
+        return
+    from kaboodle_tpu.telemetry import ManifestWriter
+
+    if args.telemetry is not None:
+        with ManifestWriter(args.telemetry) as w:
+            w.write("run", metric="sim_run", **out)
+            if metrics is not None:
+                w.write_tick_metrics(metrics, counters=counters, ticks=ticks)
+            if recorder is not None:
+                w.write_recorder(recorder)
+        print(f"telemetry manifest: {args.telemetry}", file=sys.stderr)
+    if args.metrics_jsonl is not None and metrics is not None:
+        with ManifestWriter(args.metrics_jsonl) as w:
+            w.write_tick_metrics(metrics, ticks=ticks)
+        print(f"metrics manifest: {args.metrics_jsonl}", file=sys.stderr)
 
 
 def main(argv=None) -> int:
@@ -332,6 +398,13 @@ def main(argv=None) -> int:
         from kaboodle_tpu.fleet.bench import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "telemetry":
+        # Manifest summarizer/exporter subcommand (telemetry/summary.py):
+        # host-side only — reads JSONL manifests, never dispatches a
+        # device program.
+        from kaboodle_tpu.telemetry.summary import main as telemetry_main
+
+        return telemetry_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         if args.sim or args.sim_scenario:

@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--platform", choices=["cpu"], default=None,
                    help="pin the JAX platform (avoids touching a possibly-"
                    "wedged accelerator plugin)")
+    p.add_argument("--telemetry", nargs="?", const="fleet-telemetry.jsonl",
+                   default=None, metavar="PATH",
+                   help="write the sweep result as a JSONL run manifest "
+                        "(telemetry/manifest.py schema; one 'run' record "
+                        "plus one 'sweep_bucket' record per knob value)")
     return p
 
 
@@ -256,6 +261,17 @@ def main(argv=None) -> int:
     if args.platform == "cpu":
         _pin_cpu()
     line = run_sweep(args)
+    if args.telemetry is not None:
+        # The sweep's machine output as a run manifest: the same dict the
+        # tail line prints, schema-tagged so the summarizer and any other
+        # manifest consumer can ingest it alongside sim/bench manifests.
+        from kaboodle_tpu.telemetry import ManifestWriter
+
+        with ManifestWriter(args.telemetry) as w:
+            w.write("run", **{k: v for k, v in line.items() if k != "per_knob"})
+            for bucket in line.get("per_knob") or []:
+                w.write("sweep_bucket", **bucket)
+        print(f"telemetry manifest: {args.telemetry}", file=sys.stderr)
     print(json.dumps(line))
     # A completed measurement is success even when nothing converged (the
     # non-convergent region of a sweep is a designed outcome, not an error).

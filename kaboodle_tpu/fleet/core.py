@@ -170,7 +170,7 @@ def scan_axis_first(inputs: TickInputs) -> TickInputs:
     return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), inputs)
 
 
-def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True):
+def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True, telemetry: bool = False):
     """The single-mesh tick kernel vmapped over the leading ensemble axis.
 
     One compiled program advances all E members a tick; every ``lax.cond``
@@ -181,30 +181,47 @@ def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True):
     demoted-off by default (PERF.md "Pallas policy") and rejected here so a
     config that re-enables them fails loudly instead of miscompiling under
     vmap.
+
+    ``telemetry=True`` vmaps the telemetry-plane tick: member ``e``'s
+    ``ProtocolCounters`` / fingerprint digests are bit-exact with a
+    standalone telemetry run from the same seed, by the same argument as
+    the state parity contract (vmap batches the identical per-row ops).
     """
     if cfg.use_pallas_fp or cfg.use_pallas_oldest_k or cfg.use_pallas_suspicion:
         raise ValueError(
             "fleet: the fused Pallas stage kernels do not support vmap; "
             "use the default jnp formulations (use_pallas_*=False)"
         )
-    return jax.vmap(make_tick_fn(cfg, faulty=faulty))
+    vtick = jax.vmap(make_tick_fn(cfg, faulty=faulty, telemetry=telemetry))
+
+    # Named scope for jax.profiler captures (metadata only; wraps the
+    # whole vmapped dispatch so fleet ops group under one label).
+    @jax.named_scope("kaboodle:fleet_tick")
+    def fleet_tick(mesh: MeshState, inputs: TickInputs):
+        return vtick(mesh, inputs)
+
+    return fleet_tick
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "faulty"))
+@functools.partial(jax.jit, static_argnames=("cfg", "faulty", "telemetry"))
 def simulate_fleet(
     fleet: FleetState,
     inputs: TickInputs,
     cfg: SwimConfig,
     faulty: bool = True,
+    telemetry: bool = False,
 ) -> tuple[FleetState, TickMetrics]:
     """Scan the vmapped tick over ``[T, E, ...]`` stacked inputs.
 
     The ensemble twin of :func:`kaboodle_tpu.sim.runner.simulate`: one
     ``lax.scan`` dispatch advances all members T ticks and returns per-tick
     per-member metrics (``TickMetrics`` leaves shaped ``[T, E]`` — the raw
-    material of fleet/stats.py's trajectory reductions).
+    material of fleet/stats.py's trajectory reductions). With
+    ``telemetry=True`` the second element is the stacked ``TickTelemetry``
+    instead (metrics + per-member-per-tick ``ProtocolCounters`` shaped
+    ``[T, E]``, digests ``[T, E, N]``).
     """
-    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+    vtick = make_fleet_tick_fn(cfg, faulty=faulty, telemetry=telemetry)
     mesh, metrics = jax.lax.scan(vtick, fleet.mesh, inputs)
     return dataclasses.replace(fleet, mesh=mesh), metrics
 

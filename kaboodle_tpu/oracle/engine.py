@@ -168,6 +168,11 @@ class PeerEngine:
         self._rng = random.Random(seed)
         # D2: sync candidates observed this tick, in arrival order.
         self._sync_candidates: list[tuple[object, int, int]] = []
+        # Telemetry tallies (kaboodle_tpu.telemetry counter parity): the
+        # lockstep harness zeroes these each tick and reads them after the
+        # active phase — standalone (real-transport) use ignores them.
+        self.last_escalated = 0
+        self.last_removed = 0
         # Lockstep-only bookkeeping: the membership snapshot (addr ->
         # identity) at the start of the current broadcast round and the joins
         # (addr, identity) accepted during it. Under the harness, join-reply
@@ -340,6 +345,7 @@ class PeerEngine:
             self.known[peer] = dataclasses.replace(
                 self.known[peer], state=WAITING_FOR_INDIRECT_PING, since=now
             )  # kaboodle.rs:631-639
+            self.last_escalated += 1
             for proxy in proxies:
                 out.send(proxy, PingRequest(peer))
 
@@ -347,6 +353,7 @@ class PeerEngine:
             if rec.state == WAITING_FOR_INDIRECT_PING and (now - rec.since) >= timeout:
                 removed.append(peer)  # kaboodle.rs:617-627
 
+        self.last_removed += len(removed)
         for peer in removed:
             self._remove(peer)
             out.broadcast(Failed(peer))  # kaboodle.rs:641-652

@@ -35,6 +35,7 @@ import numpy as np
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.oracle.engine import (
     Ack,
+    Join,
     KnownPeersMsg,
     KnownPeersRequest,
     PeerEngine,
@@ -94,6 +95,10 @@ class LockstepMesh:
                     eng.known[j] = PeerRecord(self.identities[j], KNOWN, 0)
         # Message log of the current tick, for tests/metrics.
         self.last_tick_messages = 0
+        # Telemetry tallies of the current tick: the oracle side of the
+        # ProtocolCounters parity contract (kaboodle_tpu/telemetry/counters.py
+        # for the definitions; tests/test_fuzz_parity.py pins kernel == this).
+        self.last_tick_counters: dict[str, int] = {}
 
     # --- churn ---------------------------------------------------------------
 
@@ -170,8 +175,16 @@ class LockstepMesh:
     def tick(self) -> None:
         now = self.tick_count
         self.last_tick_messages = 0
+        # Pre-tick snapshot for the pre/post telemetry counters. Callers
+        # apply kill/revive *before* tick(), so this is the post-churn state
+        # — the same S0 the kernel's counters snapshot.
+        pre_state = self.state_matrix()
+        gossip_records = 0
 
         # A: active phase.
+        for eng in self.engines:
+            eng.last_escalated = 0
+            eng.last_removed = 0
         broadcasts: list[tuple[int, object]] = []
         round1: list[tuple[int, int, object]] = []
         for i, eng in enumerate(self.engines):
@@ -181,6 +194,24 @@ class LockstepMesh:
             broadcasts.extend((i, b) for b in out.broadcasts)
             round1.extend((i, d, m) for d, m in out.unicasts)
 
+        def n_sent(msgs, typ):
+            return sum(1 for (_, _, m) in msgs if isinstance(m, typ))
+
+        c = {
+            "suspicions_raised": sum(e.last_escalated for e in self.engines),
+            "deaths_declared": sum(e.last_removed for e in self.engines),
+            # "Sent" counts datagrams entering the transport, post the D8
+            # validity filter (self-sends / out-of-range never enter it) —
+            # matching the kernel's ``man_tgt`` gate; random and proxy pings
+            # are valid by construction.
+            "pings_sent": sum(
+                1
+                for (s, d, m) in round1
+                if isinstance(m, Ping) and 0 <= d < self.n and d != s
+            ),
+            "ping_reqs_sent": n_sent(round1, PingRequest),
+        }
+
         # B: broadcast delivery; join responses land with round 2. Each
         # engine's D5 snapshot (start-of-round membership + joins accepted so
         # far) is what the aligned share-cap trims against.
@@ -188,6 +219,23 @@ class LockstepMesh:
             eng._round_base = {a: r.identity for a, r in eng.known.items()}
             eng._round_joins = []
         join_responses = self._deliver_broadcasts(broadcasts, now)
+        # Join dissemination: deliveries of Join datagrams (origin != self;
+        # the same gates _deliver_broadcasts applies).
+        c["joins_disseminated"] = sum(
+            sum(
+                1
+                for r in range(self.n)
+                if r != origin
+                and self.alive[r]
+                and self.delivery_ok(origin, r, now)
+            )
+            for origin, msg in broadcasts
+            if isinstance(msg, Join) and self.alive[origin]
+        )
+        gossip_records += sum(
+            len(m.peers) for (_, _, m) in join_responses
+            if isinstance(m, KnownPeersMsg)
+        )
 
         # C..F: four unicast delivery rounds resolve the ping / ping-req /
         # ack / forwarded-ack chains within the tick.
@@ -198,6 +246,12 @@ class LockstepMesh:
         # The chain is at most 4 deep (ping-req -> proxy ping -> ack ->
         # forwarded ack); anything further would break kernel parity.
         assert not leftovers, f"unexpected round-5 messages: {leftovers}"
+        # Proxy pings dispatch on a delivered PingRequest (round 2); acks on
+        # any delivered ping, plus call-3 coincidence pops and call-4 relays.
+        c["pings_sent"] += n_sent(round2, Ping)
+        c["acks_sent"] = (
+            n_sent(round2, Ack) + n_sent(round3, Ack) + n_sent(round4, Ack)
+        )
 
         # G: anti-entropy resolution (deviation D2: <= 1 request per peer).
         requests: list[tuple[int, int, KnownPeersRequest]] = []
@@ -213,11 +267,36 @@ class LockstepMesh:
         final = self._deliver_round(replies, now)
         assert all(isinstance(m, KnownPeersMsg) for (_, _, m) in replies)
         assert not final
+        gossip_records += sum(len(m.peers) for (_, _, m) in replies)
 
         # D3: curious-peer relay entries do not outlive the tick (the kernel
         # resolves the whole indirect-ping chain in-tick and stores nothing).
         for eng in self.engines:
             eng.curious.clear()
+
+        # Pre/post counters + the modeled gossip byte total (RECORD_BYTES
+        # per (addr, identity) record, modular uint32 like the kernel's).
+        post_state = self.state_matrix()
+        from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+        from kaboodle_tpu.telemetry.counters import RECORD_BYTES
+
+        c["suspicions_refuted"] = int(
+            (
+                (pre_state == WAITING_FOR_INDIRECT_PING) & (post_state == KNOWN)
+            ).sum()
+        )
+        alive_rows = np.asarray(self.alive, dtype=bool)[:, None]
+        c["armed_timers"] = int(
+            (
+                alive_rows
+                & (
+                    (post_state == WAITING_FOR_PING)
+                    | (post_state == WAITING_FOR_INDIRECT_PING)
+                )
+            ).sum()
+        )
+        c["gossip_bytes"] = (RECORD_BYTES * gossip_records) % (1 << 32)
+        self.last_tick_counters = c
 
         self.tick_count += 1
 

@@ -207,12 +207,18 @@ def row_matrix_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(PEER_AXIS, None))
 
 
-def make_sharded_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
+def make_sharded_tick(
+    cfg: SwimConfig, mesh: Mesh, faulty: bool = True, telemetry: bool = False
+):
     """Tick fn whose output carry is constrained back onto the mesh layout.
 
     The constraint after every tick keeps the scan carry's sharding fixed, so
-    XLA partitions each tick identically instead of re-deciding layouts."""
-    tick = make_tick_fn(cfg, faulty=faulty)
+    XLA partitions each tick identically instead of re-deciding layouts.
+    ``telemetry=True`` selects the telemetry-plane tick (the outputs are
+    per-tick scalars plus an [N] digest vector, which GSPMD reduces/gathers
+    like the existing metrics — only the constrained carry needs the pin).
+    """
+    tick = make_tick_fn(cfg, faulty=faulty, telemetry=telemetry)
 
     def sharded_tick(st: MeshState, inp: TickInputs):
         st, m = tick(st, inp)

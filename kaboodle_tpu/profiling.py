@@ -130,6 +130,37 @@ def warp_stats(dense_ticks, metrics: TickMetrics | None) -> np.ndarray:
     return out
 
 
+def warp_summary(dense_ticks, total_ticks: int,
+                 metrics: TickMetrics | None = None) -> dict:
+    """Ratio-style summary of a warped run, safe at every degenerate shape.
+
+    An already-converged entry state leaps the WHOLE schedule (zero dense
+    ticks, ``metrics is None``) — and a zero-length schedule runs nothing at
+    all — so every ratio here guards its denominator instead of trusting
+    the caller: ``dense_fraction``/``leaped_fraction`` are 0.0/1.0 on an
+    all-leaped run and both 0.0 on an empty one, and
+    ``mean_msgs_per_dense_tick`` is 0.0 when no dense tick executed.
+    """
+    dense = int(np.asarray(dense_ticks).size)
+    total = int(total_ticks)
+    if dense > total:
+        raise ValueError(f"dense_ticks ({dense}) exceeds total_ticks ({total})")
+    msgs = (
+        int(np.asarray(metrics.messages_delivered).sum())
+        if metrics is not None
+        else 0
+    )
+    return {
+        "total_ticks": total,
+        "dense_ticks": dense,
+        "leaped_ticks": total - dense,
+        "dense_fraction": dense / total if total else 0.0,
+        "leaped_fraction": (total - dense) / total if total else 0.0,
+        "messages_delivered": msgs,
+        "mean_msgs_per_dense_tick": msgs / dense if dense else 0.0,
+    }
+
+
 def log_run(metrics: TickMetrics, emit=print) -> None:
     """Per-tick one-liners (the RUST_LOG=debug analogue, main.rs:54-58)."""
     for row in tick_stats(metrics):

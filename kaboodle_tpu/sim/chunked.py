@@ -68,6 +68,11 @@ from kaboodle_tpu.ops.sampling import (
 )
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+from kaboodle_tpu.telemetry.counters import (
+    RECORD_BYTES,
+    ProtocolCounters,
+    TickTelemetry,
+)
 
 _I32MAX = jnp.iinfo(jnp.int32).max
 
@@ -94,6 +99,7 @@ def make_chunked_tick_fn(
     block: int = 1024,
     drop: bool = True,
     boot_union: bool = False,
+    telemetry: bool = False,
 ) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
     """Build the row-blocked tick for a given config (see module docstring).
 
@@ -109,6 +115,12 @@ def make_chunked_tick_fn(
     The Pallas stage kernels and the fast/slow split do not apply here
     (this path is its own memory-bound formulation); every other config
     flag behaves exactly as in ``make_tick_fn``.
+
+    ``telemetry=True`` is the telemetry-plane build (the chunked half of the
+    ``make_tick_fn`` contract): returns ``(state, TickTelemetry)`` with the
+    same :class:`ProtocolCounters` definitions, every added reduction either
+    O(block·N)-blocked or gated on the phase that feeds it, counters
+    bit-exact with the dense telemetry build wherever state parity holds.
 
     ``boot_union=True`` replaces the O(N^3) join-gossip contraction with
     its closed form for the fresh broadcast-boot avalanche. PRECONDITION
@@ -605,7 +617,10 @@ def make_chunked_tick_fn(
 
         def _compose_plain():
             res = pmap_blocks(_make_compose(False))
-            return res + (jnp.int32(0),)  # join-reply message count
+            out = res + (jnp.int32(0),)  # join-reply message count
+            if telemetry:
+                out = out + (jnp.int32(0),)  # join-share records sent
+            return out
 
         def _compose_with_join():
             # row_count_a: membership counts on the post-A2 state (A3 moves
@@ -627,9 +642,38 @@ def make_chunked_tick_fn(
                     jax.random.fold_in(key_bern, s0 // block),
                     reply_p, (block, n), det,
                 )
-                return is_new & bern & ok_b
+                reply = is_new & bern
+                if not telemetry:
+                    return reply & ok_b
+                # Records in the join-response shares SENT from these rows
+                # (kernel.py _join_replies' telemetry arithmetic, blocked):
+                # the ``reply`` gate (not reply & ok_b — the response unicast
+                # may still drop), sequential-map size uncapped, D5 cap model
+                # over it.
+                if cfg.max_share_peers:
+                    cap = jnp.int32(cfg.max_share_peers)
+                    within_cap = (
+                        jnp.cumsum(member_b.astype(jnp.int32), axis=1) <= cap
+                    )
+                    base_c = member_b & within_cap
+                    clen = jnp.minimum(
+                        row_count_a[blk_idx(s0)], cap
+                    )[:, None] + jnp.cumsum(
+                        (Jm_b & ~base_c).astype(jnp.int32), axis=1
+                    )
+                    rec_cnt = jnp.where(n_after <= cap, n_after, clen)
+                else:
+                    rec_cnt = n_after
+                recs = jnp.sum(
+                    jnp.where(reply, rec_cnt, 0), axis=-1, dtype=jnp.int32
+                )
+                return reply & ok_b, recs
 
-            reply_del = pmap_blocks(_reply_rows)
+            if telemetry:
+                reply_del, join_rec_rows = pmap_blocks(_reply_rows)
+                join_records = jnp.sum(join_rec_rows, dtype=jnp.int32)
+            else:
+                reply_del = pmap_blocks(_reply_rows)
 
             if boot_union:
                 # Closed-form avalanche union (see make_chunked_tick_fn
@@ -646,7 +690,10 @@ def make_chunked_tick_fn(
 
                 gossip = pmap_blocks(_union_rows_boot)
                 res = pmap_blocks(_make_compose(True, reply_del, gossip))
-                return res + (jnp.sum(reply_del, dtype=jnp.int32),)
+                out = res + (jnp.sum(reply_del, dtype=jnp.int32),)
+                if telemetry:
+                    out = out + (join_records,)
+                return out
 
             # Gate the O(N^3) contraction on a reply actually existing (same
             # rationale as kernel.py _join_replies: a rebroadcast into a
@@ -685,7 +732,10 @@ def make_chunked_tick_fn(
                 lambda: jnp.zeros((n, n), dtype=bool),
             )
             res = pmap_blocks(_make_compose(True, reply_del, gossip))
-            return res + (jnp.sum(reply_del, dtype=jnp.int32),)
+            out = res + (jnp.sum(reply_del, dtype=jnp.int32),)
+            if telemetry:
+                out = out + (join_records,)
+            return out
 
         if cfg.join_broadcast_enabled:
             comp = jax.lax.cond(any_join, _compose_with_join, _compose_plain)
@@ -696,7 +746,10 @@ def make_chunked_tick_fn(
         fp0, n0, dfp1, dn1, dfp2, dn2 = (next(it) for _ in range(6))
         lat = next(it) if has_lat else lat
         idv = next(it) if has_idv else idv
-        msgs_join = comp[-1]
+        if telemetry:
+            msgs_join, join_records = comp[-2], comp[-1]
+        else:
+            msgs_join = comp[-1]
         fp1, n1 = fp0 + dfp1, n0 + dn1
 
         # ---- fp2 (escalation-gated full read; kernel.py fp2) ---------------
@@ -895,18 +948,33 @@ def make_chunked_tick_fn(
                 out = [Sb2, Tb2]
                 if has_idv:
                     out.append(jnp.where(rep_ins, id_row, vb))
+                if telemetry:
+                    # Records in the replies these requesters' partners SENT
+                    # (kernel.py _g_apply telemetry): every delivered request
+                    # is answered; ``share`` already excludes the partner's
+                    # self-entry, the requester's own column is subtracted.
+                    own = share[jnp.arange(block, dtype=jnp.int32), gi]
+                    out.append(jnp.where(
+                        del_kpr[gi],
+                        jnp.sum(share, axis=-1, dtype=jnp.int32)
+                        - own.astype(jnp.int32),
+                        0,
+                    ))
                 return tuple(out)
 
             g2 = pmap_blocks(_g2_rows)
             it = iter(g2)
             S2, T2 = next(it), next(it)
             v2 = next(it) if has_idv else None
+            ae_rows = next(it) if telemetry else None
             fp_f, n_f = pmap_blocks(_fp_rows_of(S2, v2))
             out = [S2, T2, fp_f, n_f]
             if has_lat:
                 out.append(l1)
             if has_idv:
                 out.append(v2)
+            if telemetry:
+                out.append(jnp.sum(ae_rows, dtype=jnp.int32))
             return tuple(out)
 
         def _g_skip(args):
@@ -915,6 +983,8 @@ def make_chunked_tick_fn(
                 out.append(lat)
             if has_idv:
                 out.append(idv)
+            if telemetry:
+                out.append(jnp.int32(0))
             return tuple(out)
 
         gph = jax.lax.cond(jnp.any(del_kpr), _g_phase, _g_skip, ())
@@ -922,6 +992,7 @@ def make_chunked_tick_fn(
         S, T, fp_f, n_f = next(it), next(it), next(it), next(it)
         lat = next(it) if has_lat else None
         idv = next(it) if has_idv else None
+        ae_records = gph[-1] if telemetry else None
 
         # ---- metrics + next state (kernel.py _finish) ----------------------
         msgs = (
@@ -960,6 +1031,71 @@ def make_chunked_tick_fn(
             fingerprint_min=fpa_min,
             fingerprint_max=fpa_max,
         )
-        return new_state, metrics
+        if not telemetry:
+            return new_state, metrics
+
+        # ---- telemetry counters (kernel.py's definitions, blocked) ---------
+        # A2 removals, recomputed from the pre-tick snapshot only on ticks
+        # where A2 fired (the two terms are disjoint — kernel.py note).
+        def _wfip_cells(s0):
+            Sb = _slice_rows(S0, s0, block)
+            Tb = _slice_rows(T0, s0, block)
+            return jnp.sum(
+                alive[blk_idx(s0)][:, None]
+                & (Sb == WAITING_FOR_INDIRECT_PING)
+                & ((t - Tb) >= cfg.ping_timeout_ticks),
+                axis=-1,
+                dtype=jnp.int32,
+            )
+
+        deaths = jax.lax.cond(
+            any_a2,
+            lambda: jnp.sum(pmap_blocks(_wfip_cells), dtype=jnp.int32)
+            + jnp.sum(insta_remove, dtype=jnp.int32),
+            lambda: jnp.int32(0),
+        )
+        if cfg.join_broadcast_enabled:
+            joins_diss = jax.lax.cond(
+                any_join,
+                lambda: jnp.sum(
+                    pmap_blocks(
+                        lambda s0: jnp.sum(
+                            join_b[None, :] & okT_rows(s0) & ~blk_eye(s0),
+                            axis=-1,
+                            dtype=jnp.int32,
+                        )
+                    ),
+                    dtype=jnp.int32,
+                ),
+                lambda: jnp.int32(0),
+            )
+        else:
+            joins_diss = jnp.int32(0)
+        counters = ProtocolCounters(
+            pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
+            + jnp.sum(man_tgt >= 0, dtype=jnp.int32)
+            + jnp.sum(del_pr, dtype=jnp.int32),
+            acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
+            + jnp.sum(ok_man, dtype=jnp.int32)
+            + jnp.sum(del_pping, dtype=jnp.int32)
+            + jnp.sum(fwd, dtype=jnp.int32)
+            + jnp.sum(fwd_c, dtype=jnp.int32),
+            ping_reqs_sent=jnp.sum(proxies_valid, dtype=jnp.int32),
+            suspicions_raised=jnp.sum(escalate, dtype=jnp.int32),
+            suspicions_refuted=jnp.sum(
+                (S0 == WAITING_FOR_INDIRECT_PING) & (S == KNOWN),
+                dtype=jnp.int32,
+            ),
+            deaths_declared=deaths,
+            joins_disseminated=joins_diss,
+            gossip_bytes=jnp.uint32(RECORD_BYTES)
+            * (ae_records + join_records).astype(jnp.uint32),
+            armed_timers=jnp.sum(
+                alive[:, None]
+                & ((S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)),
+                dtype=jnp.int32,
+            ),
+        )
+        return new_state, TickTelemetry(metrics=metrics, counters=counters, fp=fp_f)
 
     return tick

@@ -63,6 +63,7 @@ Memory/layout notes (TPU):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 import jax
@@ -82,6 +83,11 @@ from kaboodle_tpu.ops.sampling import (
 )
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+from kaboodle_tpu.telemetry.counters import (
+    RECORD_BYTES,
+    ProtocolCounters,
+    TickTelemetry,
+)
 
 _I32MAX = jnp.iinfo(jnp.int32).max
 
@@ -129,12 +135,25 @@ def make_tick_fn(
     cfg: SwimConfig,
     faulty: bool = True,
     _cut: str | None = None,
+    telemetry: bool = False,
 ) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
     """Build the jittable tick function for a given protocol config.
 
     ``cfg`` is baked in (static): protocol constants fold into the compiled
     program. ``faulty=False`` compiles out the churn/partition/drop paths for
     the fault-free fast path (bench configs 2 and 4).
+
+    ``telemetry=True`` compiles the telemetry-plane build: the tick returns
+    ``(state, TickTelemetry(metrics, counters, fp))`` instead of
+    ``(state, TickMetrics)``, where ``counters`` is the
+    :class:`~kaboodle_tpu.telemetry.counters.ProtocolCounters` pytree of
+    this tick's protocol reductions and ``fp`` the end-of-tick per-member
+    fingerprint vector (the flight recorder's digest plane). Every counter
+    is a pure derived value of masks/states the tick already computes: the
+    state trajectory is bit-identical with telemetry on or off, and the
+    ``telemetry=False`` program is byte-for-byte today's (the flag only
+    *adds* outputs). Counter semantics are pinned against the lockstep
+    oracle's tallies by the counter-parity fuzz (tests/test_fuzz_parity.py).
 
     ``_cut`` is a perf-probe hook (scripts/tpu_stage_probe.py), not protocol
     surface: a static phase label ("A", "c1", "c2", "c34", "G") that truncates
@@ -151,11 +170,18 @@ def make_tick_fn(
         # A typoed label would silently compile the normal full tick and a
         # stage probe would bank a full-tick time as a phase-cut measurement.
         raise ValueError(f"unknown _cut label {_cut!r}")
+    if telemetry and _cut is not None:
+        # _cut returns partial state with zeroed metrics — counters over a
+        # truncated tick would be meaningless numbers with real-looking names.
+        raise ValueError("telemetry=True is incompatible with a _cut probe")
 
     # The closure is traced from ANOTHER module (runner.simulate's lax.scan /
     # the jax.jit call sites in tests and scripts), which per-module
     # reachability cannot see — the pragma keeps the KB2xx tracer rules live
-    # on the hottest function in the repo.
+    # on the hottest function in the repo. The named scope labels the tick's
+    # ops in jax.profiler captures (name-stack metadata only — numerics and
+    # compiled-program identity are unchanged).
+    @jax.named_scope("kaboodle:tick")
     def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:  # graftlint: traced
         n = st.state.shape[-1]
         t = st.tick
@@ -420,14 +446,35 @@ def make_tick_fn(
                 # two-pass order): a partner's own fresh call-G marks must not
                 # leak into the rows it shares this tick.
                 S_share, T_share = S, T
+
+                def _share_f():
+                    return (S_share == KNOWN) & ~eye & (
+                        (t - T_share) < cfg.max_peer_share_age_ticks
+                    )
+
+                if telemetry:
+                    # Records in the replies SENT this tick: a partner answers
+                    # every delivered request (del_kpr gates the send, not
+                    # del_rep — the reply's own delivery may still drop), and
+                    # the oracle's share additionally excludes the requester,
+                    # subtracted per edge so counts match its share lists.
+                    share_t = _share_f()
+                    share_cnt = jnp.sum(share_t, axis=-1, dtype=jnp.int32)
+                    ae_records = jnp.sum(
+                        jnp.where(
+                            del_kpr,
+                            share_cnt[jnp.clip(partner, 0)]
+                            - _gather_edge(share_t, partner, idx).astype(jnp.int32),
+                            0,
+                        ),
+                        dtype=jnp.int32,
+                    )
                 mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
                 S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
                 T = jnp.where(mark_rep, tT, T)
 
                 def _kpr_reply_insert(S, T, idv):
-                    share_f = (S_share == KNOWN) & ~eye & (
-                        (t - T_share) < cfg.max_peer_share_age_ticks
-                    )
+                    share_f = share_t if telemetry else _share_f()
                     srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
                     rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
                     S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
@@ -449,8 +496,19 @@ def make_tick_fn(
                     S, T, idv,
                 )
                 fp_f, n_f = fp_count(S, idv)
+                if telemetry:
+                    return S, T, lat, idv, fp_f, n_f, ae_records
                 return S, T, lat, idv, fp_f, n_f
 
+            if telemetry:
+                return jax.lax.cond(
+                    jnp.any(del_kpr),
+                    _g_apply,
+                    lambda S, T, lat, idv: (
+                        S, T, lat, idv, fp_g, n_g, jnp.int32(0)
+                    ),
+                    S, T, lat, idv,
+                )
             return jax.lax.cond(
                 jnp.any(del_kpr),
                 _g_apply,
@@ -486,8 +544,18 @@ def make_tick_fn(
             peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
             return prio0, peer0, prio1, peer1
 
-        def _finish(S, T, lat, idv, kpr_partner_new, fp_g, n_g, fp_f, n_f, msgs):
-            """Metrics + next-state assembly, shared by both branches."""
+        def _finish(
+            S, T, lat, idv, kpr_partner_new, fp_g, n_g, fp_f, n_f, msgs,
+            counters=None,
+        ):
+            """Metrics + next-state assembly, shared by both branches.
+
+            In telemetry builds ``counters`` carries the branch's event
+            counts; the two pre/post-state counters — suspicions refuted
+            (WaitingForIndirectPing at S0 -> Known now) and armed timers
+            (waiting cells in alive rows at tick end) — are filled in here,
+            where both snapshots are in scope, and the per-member ``fp_f``
+            vector rides out as the flight-recorder digest plane."""
             converged, fpa_min, fpa_max, n_alive = fingerprint_agreement(
                 alive, fp_f
             )
@@ -521,6 +589,22 @@ def make_tick_fn(
                 fingerprint_min=fpa_min,
                 fingerprint_max=fpa_max,
             )
+            if telemetry:
+                counters = dataclasses.replace(
+                    counters,
+                    suspicions_refuted=jnp.sum(
+                        (S0 == WAITING_FOR_INDIRECT_PING) & (S == KNOWN),
+                        dtype=jnp.int32,
+                    ),
+                    armed_timers=jnp.sum(
+                        alive[:, None]
+                        & ((S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)),
+                        dtype=jnp.int32,
+                    ),
+                )
+                return new_state, TickTelemetry(
+                    metrics=metrics, counters=counters, fp=fp_f
+                )
             return new_state, metrics
 
         def _rest(S=S, T=T, lat=lat, idv=idv):
@@ -714,16 +798,53 @@ def make_tick_fn(
                     _union,
                     lambda: jnp.zeros((n, n), dtype=bool),
                 )
+                if telemetry:
+                    # Records in the join-response shares SENT (``reply``, not
+                    # ``reply_del_`` — the response unicast may still drop).
+                    # Uncapped, the share to joiner o is r's sequential map at
+                    # reply time, whose size is exactly ``n_after`` (Q5/D9:
+                    # start-of-round map union joins <= o). Over the D5 cap
+                    # the share is the capped base plus — uncapped — this
+                    # round's joiners not already in it, exactly the oracle's
+                    # _share_snapshot_join arithmetic.
+                    if cfg.max_share_peers:
+                        cap = jnp.int32(cfg.max_share_peers)
+                        within_cap_t = (
+                            jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cap
+                        )
+                        base_c = member_a & within_cap_t
+                        clen = jnp.minimum(row_count_a, cap)[:, None] + jnp.cumsum(
+                            (Jm & ~base_c).astype(jnp.int32), axis=1
+                        )
+                        rec_cnt = jnp.where(n_after <= cap, n_after, clen)
+                    else:
+                        rec_cnt = n_after
+                    join_records_ = jnp.sum(
+                        jnp.where(reply, rec_cnt, 0), dtype=jnp.int32
+                    )
+                    return reply_del_, gossip_, join_records_
                 return reply_del_, gossip_
 
             if cfg.join_broadcast_enabled:
-                reply_del, gossip = jax.lax.cond(
-                    any_join,
-                    _join_replies,
-                    lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
-                )
+                if telemetry:
+                    reply_del, gossip, join_records = jax.lax.cond(
+                        any_join,
+                        _join_replies,
+                        lambda: (
+                            jnp.zeros((n, n), dtype=bool),
+                            jnp.zeros((n, n), dtype=bool),
+                            jnp.int32(0),
+                        ),
+                    )
+                else:
+                    reply_del, gossip = jax.lax.cond(
+                        any_join,
+                        _join_replies,
+                        lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
+                    )
             else:
                 reply_del = gossip = jnp.zeros((n, n), dtype=bool)
+                join_records = jnp.int32(0)
 
             # ============= Call 1: Pings + PingRequests =======================
             ok_ping = has_ping & ok_edge(idx, ping_tgt)
@@ -934,9 +1055,14 @@ def make_tick_fn(
             if _cut == "G":
                 return _early_return(S, T, lat, idv)
 
-            S, T, lat, idv, fp_f, n_f = _anti_entropy(
-                S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
-            )
+            if telemetry:
+                S, T, lat, idv, fp_f, n_f, ae_records = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+            else:
+                S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
 
             msgs = (
                 jnp.sum(ok_ping, dtype=jnp.int32)
@@ -952,9 +1078,44 @@ def make_tick_fn(
                 + jnp.sum(del_kpr, dtype=jnp.int32)
                 + jnp.sum(del_rep, dtype=jnp.int32)
             )
+            counters = None
+            if telemetry:
+                # A2 removals (WFIP timeouts + no-proxy insta-removes),
+                # recomputed from the pre-tick snapshot only on ticks where
+                # A2 fired — _a2_rem's two terms are disjoint (an insta row's
+                # jstar cell is a timed-out WaitingForPing, never WFIP).
+                deaths = jax.lax.cond(
+                    any_a2,
+                    lambda: jnp.sum(
+                        alive[:, None]
+                        & (S0 == WAITING_FOR_INDIRECT_PING)
+                        & (age0 >= cfg.ping_timeout_ticks),
+                        dtype=jnp.int32,
+                    )
+                    + jnp.sum(insta_remove, dtype=jnp.int32),
+                    lambda: jnp.int32(0),
+                )
+                counters = ProtocolCounters(
+                    pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
+                    + jnp.sum(man_tgt >= 0, dtype=jnp.int32)
+                    + jnp.sum(del_pr, dtype=jnp.int32),
+                    acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
+                    + jnp.sum(ok_man, dtype=jnp.int32)
+                    + jnp.sum(del_pping, dtype=jnp.int32)
+                    + jnp.sum(fwd, dtype=jnp.int32)
+                    + jnp.sum(fwd_c, dtype=jnp.int32),
+                    ping_reqs_sent=jnp.sum(proxies_valid, dtype=jnp.int32),
+                    suspicions_raised=jnp.sum(escalate, dtype=jnp.int32),
+                    suspicions_refuted=jnp.int32(0),  # filled by _finish
+                    deaths_declared=deaths,
+                    joins_disseminated=jnp.sum(Jm, dtype=jnp.int32),
+                    gossip_bytes=jnp.uint32(RECORD_BYTES)
+                    * (ae_records + join_records).astype(jnp.uint32),
+                    armed_timers=jnp.int32(0),  # filled by _finish
+                )
             return _finish(
                 S, T, lat, idv, jnp.where(del_kpr, partner, -1),
-                fp_g, n_g, fp_f, n_f, msgs,
+                fp_g, n_g, fp_f, n_f, msgs, counters,
             )
 
         def _fast(S=S, T=T, lat=lat, idv=idv):
@@ -1093,9 +1254,14 @@ def make_tick_fn(
             del_kpr = has_req & ok_edge(idx, partner)
             del_rep = del_kpr & ok_edge(partner, idx)
 
-            S, T, lat, idv, fp_f, n_f = _anti_entropy(
-                S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
-            )
+            if telemetry:
+                S, T, lat, idv, fp_f, n_f, ae_records = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+            else:
+                S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
             msgs = (
                 jnp.sum(ok_ping, dtype=jnp.int32)
                 + jnp.sum(ok_man, dtype=jnp.int32)
@@ -1104,9 +1270,29 @@ def make_tick_fn(
                 + jnp.sum(del_kpr, dtype=jnp.int32)
                 + jnp.sum(del_rep, dtype=jnp.int32)
             )
+            counters = None
+            if telemetry:
+                # Fast ticks carry no escalation, no join, no A2 removal —
+                # the event counters those feed are structurally zero here
+                # (the _rest formulas reduce to exactly these on such ticks,
+                # so the lax.cond dispatch cannot make counters diverge).
+                counters = ProtocolCounters(
+                    pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
+                    + jnp.sum(man_tgt >= 0, dtype=jnp.int32),
+                    acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
+                    + jnp.sum(ok_man, dtype=jnp.int32),
+                    ping_reqs_sent=jnp.int32(0),
+                    suspicions_raised=jnp.int32(0),
+                    suspicions_refuted=jnp.int32(0),  # filled by _finish
+                    deaths_declared=jnp.int32(0),
+                    joins_disseminated=jnp.int32(0),
+                    gossip_bytes=jnp.uint32(RECORD_BYTES)
+                    * ae_records.astype(jnp.uint32),
+                    armed_timers=jnp.int32(0),  # filled by _finish
+                )
             return _finish(
                 S, T, lat, idv, jnp.where(del_kpr, partner, -1),
-                fp_g, n_g, fp_f, n_f, msgs,
+                fp_g, n_g, fp_f, n_f, msgs, counters,
             )
 
         # ---- dispatch ---------------------------------------------------------

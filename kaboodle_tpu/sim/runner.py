@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, idle_inputs
+from kaboodle_tpu.telemetry.counters import add_counters, zero_counters
+from kaboodle_tpu.telemetry.recorder import init_recorder, record_tick
 
 
 def simulate(
@@ -33,6 +35,46 @@ def simulate(
     """Scan the tick kernel over ``inputs`` stacked along a leading [T] axis."""
     tick = make_tick_fn(cfg, faulty=faulty)
     return jax.lax.scan(tick, state, inputs)
+
+
+def simulate_with_telemetry(
+    state: MeshState,
+    inputs: TickInputs,
+    cfg: SwimConfig,
+    faulty: bool = True,
+    recorder_len: int = 0,
+):
+    """The :func:`simulate` scan with the telemetry plane on.
+
+    Returns ``(final_state, metrics, counters, recorder)``: per-tick
+    ``TickMetrics`` and ``ProtocolCounters`` stacked ``[T]``, and — when
+    ``recorder_len > 0`` — a :class:`~kaboodle_tpu.telemetry.recorder.
+    FlightRecorder` ring carried through the scan holding the last
+    ``recorder_len`` ticks' counters + per-member fingerprint digests
+    (``None`` otherwise). The state trajectory is bit-identical to
+    :func:`simulate`'s; everything here is added outputs.
+    """
+    tick = make_tick_fn(cfg, faulty=faulty, telemetry=True)
+    if recorder_len:
+        rec0 = init_recorder(recorder_len, state.n)
+
+        def body(carry, inp):
+            st, rec = carry
+            st, out = tick(st, inp)
+            rec = record_tick(rec, st.tick - 1, out)
+            return (st, rec), (out.metrics, out.counters)
+
+        (final, rec), (metrics, counters) = jax.lax.scan(
+            body, (state, rec0), inputs
+        )
+        return final, metrics, counters, rec
+
+    def body(st, inp):
+        st, out = tick(st, inp)
+        return st, (out.metrics, out.counters)
+
+    final, (metrics, counters) = jax.lax.scan(body, state, inputs)
+    return final, metrics, counters, None
 
 
 def state_agreement(state: MeshState):
@@ -96,3 +138,50 @@ def run_until_converged(
 ) -> tuple[MeshState, jax.Array, jax.Array]:
     """Tick the fault-free kernel until fingerprint agreement or ``max_ticks``."""
     return converge_loop(state, make_tick_fn(cfg, faulty=False), max_ticks)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_ticks", "recorder_len")
+)
+def run_until_converged_telemetry(
+    state: MeshState,
+    cfg: SwimConfig,
+    max_ticks: int = 64,
+    recorder_len: int = 32,
+):
+    """:func:`run_until_converged` with the telemetry plane on.
+
+    A ``while_loop`` cannot stack per-tick outputs, so this is exactly the
+    flight recorder's home turf: the carry accumulates run-total
+    ``ProtocolCounters`` plus the last ``recorder_len`` ticks' ring, and
+    whether the run converged or hit ``max_ticks``, one host fetch dumps
+    what the tail of the run was doing — no rerun. Returns
+    ``(final_state, ticks_run, converged, totals, recorder)``; the state /
+    ticks / converged triple is bit-identical to the plain runner's
+    (entry agreement short-circuits at zero ticks the same way).
+    """
+    tick = make_tick_fn(cfg, faulty=False, telemetry=True)
+    idle = idle_inputs(state.n)
+
+    def cond(carry):
+        _, i, conv, _, _ = carry
+        return (~conv) & (i < max_ticks)
+
+    def body(carry):
+        st, i, _, rec, tot = carry
+        st, out = tick(st, idle)
+        rec = record_tick(rec, st.tick - 1, out)
+        return st, i + 1, out.metrics.converged, rec, add_counters(tot, out.counters)
+
+    st, i, conv, rec, tot = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            state,
+            jnp.int32(0),
+            state_converged(state),
+            init_recorder(recorder_len, state.n),
+            zero_counters(),
+        ),
+    )
+    return st, i, conv, tot, rec

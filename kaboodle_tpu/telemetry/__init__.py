@@ -1,0 +1,74 @@
+"""On-device telemetry plane: protocol counters, flight recorder, exporters.
+
+Three layers, from the device out (SURVEY.md §5 grown into a subsystem):
+
+- **Counters** (:mod:`telemetry.counters`): every tick engine — the dense
+  kernel, the chunked twin, the warp leap, the vmapped fleet — can emit a
+  :class:`ProtocolCounters` pytree of per-tick protocol reductions (pings /
+  acks / ping-reqs sent, suspicions raised and refuted, deaths declared,
+  joins disseminated, modeled gossip bytes, armed timers) as *pure derived
+  values*: the state trajectory is bit-identical with telemetry on or off,
+  and the lockstep oracle counts the same events so the randomized
+  cross-engine fuzz pins counter parity exactly (tests/test_fuzz_parity.py).
+- **Flight recorder** (:mod:`telemetry.recorder`): a fixed-shape on-device
+  ring buffer carried through scans and while_loops holding the last K
+  ticks of counters + per-member fingerprint digests — dumpable on
+  convergence or divergence without rerunning, no host callbacks (the
+  graftscan KB402 gate stays clean), no fresh compiles after warmup (the
+  KB405 zero-recompile fuzz arm covers a telemetry-enabled run).
+- **Export** (:mod:`telemetry.manifest` / :mod:`telemetry.trace` /
+  :mod:`telemetry.summary`): one JSONL run-manifest schema shared by
+  bench.py, the fleet sweep CLI, and the warp A/B; a Chrome-trace /
+  Perfetto JSON exporter over per-tick telemetry; and the
+  ``python -m kaboodle_tpu telemetry`` summarizer. Surfaced via
+  ``--telemetry [PATH]`` on the sim / fleet / warp CLI paths.
+"""
+
+from kaboodle_tpu.telemetry.counters import (
+    RECORD_BYTES,
+    ProtocolCounters,
+    TickTelemetry,
+    add_counters,
+    counters_table,
+    counters_totals,
+    leap_counters,
+    scale_counters,
+    zero_counters,
+)
+from kaboodle_tpu.telemetry.manifest import (
+    MANIFEST_SCHEMA,
+    ManifestWriter,
+    read_manifest,
+    run_record,
+    validate_record,
+)
+from kaboodle_tpu.telemetry.recorder import (
+    FlightRecorder,
+    init_recorder,
+    record_tick,
+    recorder_rows,
+)
+from kaboodle_tpu.telemetry.trace import chrome_trace_events, write_chrome_trace
+
+__all__ = [
+    "RECORD_BYTES",
+    "ProtocolCounters",
+    "TickTelemetry",
+    "add_counters",
+    "counters_table",
+    "counters_totals",
+    "leap_counters",
+    "scale_counters",
+    "zero_counters",
+    "MANIFEST_SCHEMA",
+    "ManifestWriter",
+    "read_manifest",
+    "run_record",
+    "validate_record",
+    "FlightRecorder",
+    "init_recorder",
+    "record_tick",
+    "recorder_rows",
+    "chrome_trace_events",
+    "write_chrome_trace",
+]

@@ -1,0 +1,180 @@
+"""ProtocolCounters: per-tick protocol event reductions, engine-agnostic.
+
+Every counter is a *pure derived value* of one tick's delivery masks and
+pre/post states — no engine mutates state to count, so a telemetry-on tick
+is bit-identical to a telemetry-off tick in everything but its outputs, and
+the lockstep oracle (oracle/lockstep.py) can tally the same events from its
+message lists for exact cross-engine parity (tests/test_fuzz_parity.py).
+
+Counter definitions (the contract every engine implements; "sent" means the
+datagram entered the transport, post the D8 validity filter — delivery may
+still drop it; "delivered" masks gate replies exactly as the protocol does):
+
+- ``pings_sent``      random A3 pings + valid manual pings + proxy pings
+                      dispatched on a *delivered* PingRequest.
+- ``acks_sent``       acks dispatched on a delivered ping (direct, manual,
+                      proxy->suspect) + forwarded acks (call-3 coincidence
+                      pops and call-4 relays).
+- ``ping_reqs_sent``  PingRequests dispatched by escalating suspectors.
+- ``suspicions_raised``   rows escalating WaitingForPing ->
+                      WaitingForIndirectPing this tick (D1: <= 1 per row).
+- ``suspicions_refuted``  cells WaitingForIndirectPing at tick start and
+                      Known at tick end (a datagram or gossip resurrected
+                      the suspect). Defined on pre/post snapshots, so an
+                      in-tick raise-and-refute is not counted — the
+                      definition is a pure function of the states the
+                      parity pins already compare.
+- ``deaths_declared`` cells removed by phase A2 (WaitingForIndirectPing
+                      timeouts + no-proxy insta-removals).
+- ``joins_disseminated``  Join broadcast deliveries (origin != receiver).
+- ``gossip_bytes``    modeled bytes of membership records gossiped:
+                      ``RECORD_BYTES`` x (records in KnownPeersRequest
+                      replies sent + records in join-response shares sent,
+                      the D5-capped share model). uint32, wraps modulo 2^32
+                      on pathological uncapped join storms (documented).
+- ``armed_timers``    waiting-state cells in alive rows at tick end — the
+                      quantity warp's quiescence predicate requires to be
+                      zero (warp/horizon.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # annotation-only: sim.kernel imports this module, so a
+    # runtime import of sim.state here would be circular whenever the
+    # telemetry package is imported before the sim package.
+    from kaboodle_tpu.sim.state import TickMetrics
+
+# Modeled wire size of one gossiped membership record: u32 address word +
+# u32 identity word (the simulator's (addr, identity) pair; the reference
+# serializes SocketAddr + identity bytes — transport/codec.py — so real
+# payloads are larger; this models the O(records) growth, not framing).
+RECORD_BYTES = 8
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ProtocolCounters:
+    """One tick's protocol event counts (module docstring for definitions).
+
+    All int32 scalars except ``gossip_bytes`` (uint32, modular). Under the
+    fleet vmap every leaf carries the leading ``[E]`` axis; stacked by a
+    scan they carry ``[T]``.
+    """
+
+    pings_sent: jax.Array  # int32 []
+    acks_sent: jax.Array  # int32 []
+    ping_reqs_sent: jax.Array  # int32 []
+    suspicions_raised: jax.Array  # int32 []
+    suspicions_refuted: jax.Array  # int32 []
+    deaths_declared: jax.Array  # int32 []
+    joins_disseminated: jax.Array  # int32 []
+    gossip_bytes: jax.Array  # uint32 [] (RECORD_BYTES x records, modular)
+    armed_timers: jax.Array  # int32 []
+
+
+FIELDS = tuple(f.name for f in dataclasses.fields(ProtocolCounters))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TickTelemetry:
+    """Telemetry-mode tick output: metrics + counters + per-member digests.
+
+    ``fp`` is the end-of-tick per-member membership fingerprint vector
+    (uint32 ``[N]``) — the flight recorder's digest plane; the state
+    trajectory itself is unchanged by telemetry mode.
+    """
+
+    metrics: TickMetrics
+    counters: ProtocolCounters
+    fp: jax.Array  # uint32 [N]
+
+
+def zero_counters() -> ProtocolCounters:
+    """All-zero counters (the leaped-span identity / accumulator seed)."""
+    z = jnp.zeros((), jnp.int32)
+    return ProtocolCounters(
+        pings_sent=z,
+        acks_sent=z,
+        ping_reqs_sent=z,
+        suspicions_raised=z,
+        suspicions_refuted=z,
+        deaths_declared=z,
+        joins_disseminated=z,
+        gossip_bytes=jnp.zeros((), jnp.uint32),
+        armed_timers=z,
+    )
+
+
+def add_counters(a: ProtocolCounters, b: ProtocolCounters) -> ProtocolCounters:
+    """Leafwise sum — run totals accumulate exactly (uint32 wraps modular)."""
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def scale_counters(c: ProtocolCounters, k) -> ProtocolCounters:
+    """``k`` identical ticks' worth of ``c`` (int multiply per leaf)."""
+    return jax.tree.map(lambda x: x * jnp.asarray(k).astype(x.dtype), c)
+
+
+def leap_counters(n_alive, k) -> ProtocolCounters:
+    """Counters of ``k`` quiescent leaped ticks, in closed form.
+
+    Inside a warp span (warp/horizon.py quiescence predicate) each tick's
+    surviving protocol traffic is exactly: every alive row pings (membership
+    == alive set and ``n_alive >= 2``, so every alive row has candidates),
+    every ping is delivered and acked within the tick (fault-free, both
+    endpoints alive), anti-entropy never fires (fingerprints agree), and no
+    timer survives the tick. So per tick: ``pings_sent == acks_sent ==
+    n_alive`` and every other counter is zero — bit-equal to what the dense
+    kernel emits on those ticks (the warp arm of the counter-parity fuzz
+    pins this).
+    """
+    per_tick = dataclasses.replace(
+        zero_counters(),
+        pings_sent=jnp.asarray(n_alive, jnp.int32),
+        acks_sent=jnp.asarray(n_alive, jnp.int32),
+    )
+    return scale_counters(per_tick, jnp.asarray(k, jnp.int32))
+
+
+def counters_table(counters: ProtocolCounters) -> np.ndarray:
+    """Stacked ``[T]`` counters -> structured NumPy table, one row per tick."""
+    first = np.atleast_1d(np.asarray(counters.pings_sent))
+    out = np.zeros(
+        first.shape[0],
+        dtype=[("tick", np.int32)]
+        + [
+            (name, np.uint32 if name == "gossip_bytes" else np.int32)
+            for name in FIELDS
+        ],
+    )
+    out["tick"] = np.arange(first.shape[0])
+    for name in FIELDS:
+        out[name] = np.atleast_1d(np.asarray(getattr(counters, name)))
+    return out
+
+
+def counters_totals(counters: ProtocolCounters) -> dict:
+    """Host-side run totals of stacked counters, as Python ints.
+
+    ``armed_timers`` is a gauge, so its total is the tick-integrated value
+    (area under the curve); every other field is a plain event count —
+    except ``gossip_bytes``, whose total wraps modulo 2^32 exactly like
+    the on-device uint32 accumulator (``add_counters`` in a while_loop
+    carry), so the two totals APIs can never disagree at any run length.
+    """
+    out = {
+        name: int(np.asarray(getattr(counters, name), dtype=np.int64).sum())
+        for name in FIELDS
+    }
+    out["gossip_bytes"] = int(
+        np.asarray(counters.gossip_bytes, dtype=np.uint64).sum() % (1 << 32)
+    )
+    return out

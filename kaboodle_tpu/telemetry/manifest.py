@@ -1,0 +1,171 @@
+"""JSONL run manifests: ONE schema for every lane's machine output.
+
+Before this module, three drivers each formatted their own JSON: bench.py
+(``BENCHDOC`` lines + BENCH_last_full.json), the fleet sweep CLI (a compact
+tail line), and the warp A/B (a third shape). A manifest is the superset
+they all need — a stream of schema-tagged records:
+
+    {"schema": "kaboodle-telemetry/1", "kind": "run",  ...lane fields...}
+    {"schema": "kaboodle-telemetry/1", "kind": "tick", "tick": 0, ...}
+    {"schema": "kaboodle-telemetry/1", "kind": "recorder", ...}
+
+``kind`` values are open (lanes add their own), but every record carries
+the schema tag and every ``tick`` record carries a ``tick`` index, so the
+summarizer (``python -m kaboodle_tpu telemetry``) and the Chrome-trace
+exporter (telemetry/trace.py) can consume any lane's manifest. Writers are
+stdlib-only and host-side — nothing here touches a traced function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterator
+
+import numpy as np
+
+MANIFEST_SCHEMA = "kaboodle-telemetry/1"
+
+
+def _jsonable(v):
+    """NumPy / JAX scalars and arrays -> plain Python (json.dumps-safe)."""
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, bool, int, float)) or v is None:
+        return v
+    a = np.asarray(v)
+    if a.ndim == 0:
+        return a.item()
+    return a.tolist()
+
+
+def run_record(kind: str = "run", **fields) -> dict:
+    """A schema-tagged manifest record (host values coerced to JSON types)."""
+    rec = {"schema": MANIFEST_SCHEMA, "kind": kind}
+    rec.update({k: _jsonable(v) for k, v in fields.items()})
+    return rec
+
+
+def validate_record(rec) -> dict:
+    """Raise ``ValueError`` unless ``rec`` is a well-formed manifest record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"manifest record must be an object, got {type(rec).__name__}")
+    if rec.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"manifest record schema {rec.get('schema')!r} != {MANIFEST_SCHEMA!r}"
+        )
+    kind = rec.get("kind")
+    if not isinstance(kind, str) or not kind:
+        raise ValueError("manifest record needs a non-empty string 'kind'")
+    if kind == "tick" and not isinstance(rec.get("tick"), int):
+        raise ValueError("'tick' records need an integer 'tick' index")
+    return rec
+
+
+class ManifestWriter:
+    """JSONL manifest writer (context manager).
+
+    One record per line; every record is validated before it is written, so
+    a manifest can never contain a line the summarizer would reject.
+
+    Default mode TRUNCATES: a manifest names one run, and re-running a CLI
+    lane with the same path must replace the old run, not silently merge
+    two runs into doubled counter totals and duplicate tick records.
+    ``append=True`` opts into accumulation for writers that deliberately
+    build a multi-record stream across processes (bench.py ``--manifest``
+    appends one ``run`` record per lane invocation).
+    """
+
+    def __init__(self, path: str, append: bool = False) -> None:
+        self.path = path
+        self._f = open(path, "a" if append else "w")
+        self.records_written = 0
+
+    def write(self, kind: str = "run", **fields) -> dict:
+        rec = validate_record(run_record(kind, **fields))
+        self._f.write(json.dumps(rec) + "\n")
+        self.records_written += 1
+        return rec
+
+    def write_tick_metrics(self, metrics, counters=None, ticks=None) -> int:
+        """Stream stacked per-tick ``TickMetrics`` (and optionally stacked
+        ``ProtocolCounters``) as ``tick`` records.
+
+        ``ticks`` overrides the tick column (warped runs: the densely
+        executed tick indices); default 0..T-1. Returns rows written.
+        Zero-tick runs (already converged at entry) write nothing — the
+        empty table is valid, not an error.
+        """
+        from kaboodle_tpu.profiling import tick_stats
+
+        table = tick_stats(metrics)
+        ctable = None
+        if counters is not None:
+            from kaboodle_tpu.telemetry.counters import counters_table
+
+            ctable = counters_table(counters)
+        for i, row in enumerate(table):
+            fields = {name: row[name] for name in table.dtype.names}
+            if ticks is not None:
+                fields["tick"] = int(np.asarray(ticks)[i])
+            if ctable is not None:
+                fields.update(
+                    {n: ctable[n][i] for n in ctable.dtype.names if n != "tick"}
+                )
+            fields["tick"] = int(fields["tick"])
+            self.write("tick", **fields)
+        return len(table)
+
+    def write_recorder(self, rec) -> dict:
+        """Dump a :class:`FlightRecorder` ring as one ``recorder`` record
+        (table rows inline; the per-member fp plane as min/max/row digests,
+        not the full [K, N] matrix — manifests stay O(K))."""
+        from kaboodle_tpu.telemetry.recorder import recorder_rows
+
+        rows = recorder_rows(rec)
+        table = rows["table"]
+        return self.write(
+            "recorder",
+            rows=[
+                {name: _jsonable(r[name]) for name in table.dtype.names}
+                for r in table
+            ],
+            fp_unique=[int(len(np.unique(f))) for f in rows["fp"]],
+        )
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "ManifestWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_manifest(path: str, validate: bool = True) -> Iterator[dict]:
+    """Yield manifest records from a JSONL file (optionally validated)."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: not JSON: {e}") from None
+            if validate:
+                try:
+                    validate_record(rec)
+                except ValueError as e:
+                    raise ValueError(f"{path}:{lineno}: {e}") from None
+            yield rec
+
+
+def dataclass_fields(obj) -> dict:
+    """Flatten a (host-fetched) dataclass pytree into manifest fields."""
+    return {
+        f.name: _jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)
+    }

@@ -1,0 +1,120 @@
+"""Flight recorder: a fixed-shape on-device ring of the last K ticks.
+
+The recorder rides the scan / while_loop *carry*: every tick writes one
+slot (counters + convergence digest + the per-member fingerprint vector)
+at ``head % K`` via ``dynamic_update_index_in_dim`` — fixed shapes, no
+host callback (graftscan KB402 stays clean), no data-dependent control
+flow, so a telemetry-enabled runner compiles once and recompiles never
+(the KB405 zero-recompile fuzz arm runs one).
+
+The payoff is post-mortem observability without rerunning: when a run
+converges (or diverges, or a parity pin trips) the host dumps the ring
+once — :func:`recorder_rows` — and gets the last K ticks' protocol
+counters and per-member fingerprint digests in chronological order, the
+exact data needed to see *why* the tail of the run looked the way it did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.telemetry.counters import (
+    FIELDS,
+    ProtocolCounters,
+    TickTelemetry,
+    counters_table,
+    zero_counters,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FlightRecorder:
+    """Ring of the last K recorded ticks (module docstring).
+
+    ``head`` counts records ever written; slot ``head % K`` is written
+    next. ``tick`` holds the simulated tick index per slot (-1 = empty).
+    """
+
+    tick: jax.Array  # int32 [K], -1 where never written
+    converged: jax.Array  # bool [K]
+    fp_min: jax.Array  # uint32 [K]
+    fp_max: jax.Array  # uint32 [K]
+    counters: ProtocolCounters  # leaves [K]
+    fp: jax.Array  # uint32 [K, N] per-member fingerprint digests
+    head: jax.Array  # int32 []
+
+    @property
+    def capacity(self) -> int:
+        return self.tick.shape[0]
+
+
+def init_recorder(k: int, n: int) -> FlightRecorder:
+    """Empty K-slot recorder for an N-peer mesh (shapes are static)."""
+    if k < 1:
+        raise ValueError("need recorder capacity k >= 1")
+    zc = jax.tree.map(lambda x: jnp.zeros((k,), x.dtype), zero_counters())
+    return FlightRecorder(
+        tick=jnp.full((k,), -1, dtype=jnp.int32),
+        converged=jnp.zeros((k,), dtype=bool),
+        fp_min=jnp.zeros((k,), dtype=jnp.uint32),
+        fp_max=jnp.zeros((k,), dtype=jnp.uint32),
+        counters=zc,
+        fp=jnp.zeros((k, n), dtype=jnp.uint32),
+        head=jnp.int32(0),
+    )
+
+
+def record_tick(
+    rec: FlightRecorder, tick: jax.Array, out: TickTelemetry
+) -> FlightRecorder:
+    """Write one tick's telemetry into the ring (pure; jit/scan-safe)."""
+    k = rec.capacity
+    slot = jax.lax.rem(rec.head, jnp.int32(k))
+
+    def put(buf, val):
+        return jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.asarray(val, buf.dtype), slot, axis=0
+        )
+
+    return FlightRecorder(
+        tick=put(rec.tick, jnp.asarray(tick, jnp.int32)),
+        converged=put(rec.converged, out.metrics.converged),
+        fp_min=put(rec.fp_min, out.metrics.fingerprint_min),
+        fp_max=put(rec.fp_max, out.metrics.fingerprint_max),
+        counters=jax.tree.map(put, rec.counters, out.counters),
+        fp=jax.lax.dynamic_update_index_in_dim(rec.fp, out.fp, slot, axis=0),
+        head=rec.head + 1,
+    )
+
+
+def recorder_rows(rec: FlightRecorder) -> dict:
+    """ONE host fetch: the ring's valid slots in chronological order.
+
+    Returns ``{"table": structured ndarray (tick, counters..., converged,
+    fp_min, fp_max), "fp": uint32 [rows, N]}`` — oldest first, at most K
+    rows (fewer when the run was shorter than the ring).
+    """
+    head = int(np.asarray(rec.head))
+    k = rec.capacity
+    rows = min(head, k)
+    order = [(head - rows + i) % k for i in range(rows)]
+    table = counters_table(
+        jax.tree.map(lambda x: np.asarray(x)[order], rec.counters)
+    )
+    merged = np.zeros(
+        rows,
+        dtype=table.dtype.descr
+        + [("converged", bool), ("fp_min", np.uint32), ("fp_max", np.uint32)],
+    )
+    for name in ("tick",) + FIELDS:
+        merged[name] = table[name]
+    merged["tick"] = np.asarray(rec.tick)[order]
+    merged["converged"] = np.asarray(rec.converged)[order]
+    merged["fp_min"] = np.asarray(rec.fp_min)[order]
+    merged["fp_max"] = np.asarray(rec.fp_max)[order]
+    return {"table": merged, "fp": np.asarray(rec.fp)[order]}

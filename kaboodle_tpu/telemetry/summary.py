@@ -1,0 +1,133 @@
+"""``python -m kaboodle_tpu telemetry`` — manifest summarizer / exporter.
+
+Reads one or more JSONL run manifests (telemetry/manifest.py), validates
+every record against the schema, and prints a human summary: records by
+kind, the run records' headline fields, per-counter totals over the tick
+records, and the convergence tail. Ends with the repo's usual compact
+single-line JSON (machine consumers take the last line). ``--trace OUT``
+additionally exports the tick records as a Chrome-trace/Perfetto JSON.
+
+    python -m kaboodle_tpu telemetry run.jsonl
+    python -m kaboodle_tpu telemetry run.jsonl --trace run.trace.json
+    python -m kaboodle_tpu telemetry run.jsonl --check   # schema gate (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kaboodle_tpu.telemetry.counters import FIELDS
+from kaboodle_tpu.telemetry.manifest import read_manifest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kaboodle_tpu telemetry",
+        description="summarize / export kaboodle telemetry run manifests",
+    )
+    p.add_argument("paths", nargs="+", metavar="MANIFEST.jsonl")
+    p.add_argument("--trace", metavar="OUT.json", default=None,
+                   help="export tick records as Chrome-trace/Perfetto JSON")
+    p.add_argument("--check", action="store_true",
+                   help="schema gate: exit nonzero unless every record "
+                        "validates and at least one record exists")
+    return p
+
+
+def load_manifests(paths: list[str]) -> dict[str, list[dict]]:
+    """Read + validate every manifest ONCE: path -> its records."""
+    return {path: list(read_manifest(path)) for path in paths}
+
+
+def summarize(records: dict[str, list[dict]]) -> dict:
+    """Aggregate loaded manifests into the summary dict the CLI prints."""
+    kinds: dict[str, int] = {}
+    runs: list[dict] = []
+    ticks: list[dict] = []
+    for recs in records.values():
+        for rec in recs:
+            kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
+            if rec["kind"] == "run":
+                runs.append(rec)
+            elif rec["kind"] == "tick":
+                ticks.append(rec)
+    out: dict = {
+        "metric": "telemetry_manifest_summary",
+        "manifests": len(records),
+        "records": int(sum(kinds.values())),
+        "kinds": kinds,
+        "runs": [
+            {k: r[k] for k in ("metric", "value", "unit", "n_peers", "ticks",
+                               "backend", "wall_s") if k in r}
+            for r in runs
+        ],
+    }
+    if ticks:
+        ticks.sort(key=lambda r: r["tick"])
+        totals = {
+            name: int(sum(int(r[name]) for r in ticks if name in r))
+            for name in FIELDS
+            if any(name in r for r in ticks)
+        }
+        conv = [r for r in ticks if "converged" in r]
+        out["tick_records"] = len(ticks)
+        out["tick_span"] = [int(ticks[0]["tick"]), int(ticks[-1]["tick"])]
+        out["counter_totals"] = totals
+        if conv:
+            out["final_converged"] = bool(conv[-1]["converged"])
+            first = next((r["tick"] for r in conv if r["converged"]), None)
+            out["first_converged_tick"] = int(first) if first is not None else -1
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        records = load_manifests(args.paths)
+        summary = summarize(records)
+    except (OSError, ValueError) as e:
+        print(f"telemetry: {e}", file=sys.stderr)
+        return 1
+    if args.check and summary["records"] == 0:
+        print("telemetry: --check: manifest has no records", file=sys.stderr)
+        return 1
+
+    print(f"telemetry: {summary['manifests']} manifest(s), "
+          f"{summary['records']} records "
+          f"({', '.join(f'{k}:{v}' for k, v in sorted(summary['kinds'].items()))})")
+    for run in summary["runs"]:
+        bits = " ".join(f"{k}={run[k]}" for k in run)
+        print(f"  run: {bits}")
+    if "counter_totals" in summary:
+        lo, hi = summary["tick_span"]
+        print(f"  ticks {lo}..{hi} ({summary['tick_records']} records)")
+        for name, v in summary["counter_totals"].items():
+            print(f"    {name:<20} {v}")
+        if "final_converged" in summary:
+            print(f"  first_converged_tick={summary.get('first_converged_tick')}"
+                  f" final_converged={summary.get('final_converged')}")
+
+    if args.trace:
+        from kaboodle_tpu.telemetry.trace import write_chrome_trace
+
+        # One Perfetto process track PER MANIFEST: each manifest is its own
+        # run, and pooling runs onto one track would corrupt the leap-gap
+        # inference (overlapping tick slices, false/masked leaps).
+        groups = {
+            path: [r for r in recs if r["kind"] == "tick"]
+            for path, recs in records.items()
+        }
+        n = write_chrome_trace(args.trace,
+                               {p: rows for p, rows in groups.items() if rows},
+                               metadata={"manifests": args.paths})
+        print(f"  trace: {n} events -> {args.trace}")
+        summary["trace_events"] = n
+
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

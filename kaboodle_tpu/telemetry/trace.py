@@ -1,0 +1,117 @@
+"""Trace export + annotation: Perfetto-loadable JSON and named scopes.
+
+Two complementary halves:
+
+- **Annotations** — :func:`op_scope` (``jax.named_scope``) labels traced
+  regions inside kernels so XLA ops in a ``jax.profiler`` capture carry
+  ``kaboodle:tick`` / ``kaboodle:leap`` / ``kaboodle:fleet_tick`` name-stack
+  prefixes; :func:`host_span` (``jax.profiler.TraceAnnotation``) brackets
+  host-driven spans (the warp runner's leap/dense segments) on the profiler
+  timeline. Both are metadata-only: numerics and compiled programs are
+  unchanged (annotations do not count against the KB405 surface).
+- **Export** — :func:`chrome_trace_events` renders per-tick telemetry rows
+  (manifest ``tick`` records, or anything shaped like them) into Chrome
+  trace events: one ``X`` slice per tick on a "protocol" track (leaped gaps
+  become ``leap`` slices), one ``C`` counter series per ProtocolCounters
+  field. :func:`write_chrome_trace` wraps them in the JSON object format
+  that chrome://tracing and https://ui.perfetto.dev load directly. The
+  timeline unit is simulated ticks (1 tick == 1 ms display time), not wall
+  clock — this is the *protocol* timeline; for device wall time use
+  ``profiling.trace`` (the jax profiler capture, already Perfetto-format).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+
+from kaboodle_tpu.telemetry.counters import FIELDS
+
+_TICK_US = 1000  # 1 simulated tick rendered as 1 ms of trace time
+
+
+def op_scope(name: str):
+    """``jax.named_scope`` under the ``kaboodle:`` prefix (trace-time only)."""
+    import jax
+
+    return jax.named_scope(f"kaboodle:{name}")
+
+
+@contextlib.contextmanager
+def host_span(name: str):
+    """Host-side profiler span (no-op cost outside an active capture)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(f"kaboodle:{name}"):
+        yield
+
+
+def chrome_trace_events(tick_rows, pid: int = 1, label: str | None = None) -> list[dict]:
+    """Per-tick telemetry rows FROM ONE RUN -> Chrome trace events.
+
+    ``tick_rows``: iterable of dicts carrying ``tick`` plus any subset of
+    the counter/metric fields (manifest ``tick`` records qualify). Rows
+    need not be contiguous — a gap between consecutive ticks is rendered as
+    one ``leap`` slice spanning it (the warp runner's leaped spans) — but
+    they MUST come from a single run: the gap inference and the one-slice-
+    per-tick layout are meaningless over pooled runs. Multiple runs get one
+    call each with distinct ``pid``s (``write_chrome_trace`` with a mapping
+    does exactly that), so each renders as its own Perfetto process track.
+    """
+    rows = sorted(tick_rows, key=lambda r: r["tick"])
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": label or "kaboodle protocol timeline"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+         "args": {"name": "ticks"}},
+    ]
+    prev_tick = None
+    for row in rows:
+        t = int(row["tick"])
+        if prev_tick is not None and t > prev_tick + 1:
+            events.append({
+                "name": "leap", "ph": "X", "pid": pid, "tid": 1,
+                "ts": (prev_tick + 1) * _TICK_US,
+                "dur": (t - prev_tick - 1) * _TICK_US,
+                "args": {"leaped_ticks": t - prev_tick - 1},
+            })
+        args = {
+            k: row[k]
+            for k in row
+            if k not in ("tick", "schema", "kind") and isinstance(row[k], (int, float, bool))
+        }
+        events.append({
+            "name": "tick", "ph": "X", "pid": pid, "tid": 1,
+            "ts": t * _TICK_US, "dur": _TICK_US, "args": args,
+        })
+        for name in FIELDS:
+            if name in row:
+                events.append({
+                    "name": name, "ph": "C", "pid": pid,
+                    "ts": t * _TICK_US, "args": {name: row[name]},
+                })
+        prev_tick = t
+    return events
+
+
+def write_chrome_trace(path: str, tick_rows, metadata: dict | None = None) -> int:
+    """Write rows as a Chrome-trace JSON file; returns the event count.
+
+    ``tick_rows`` is either one run's rows, or a ``{label: rows}`` mapping
+    of several runs — each mapping entry gets its own pid (Perfetto process
+    track), so independent runs' ticks never interleave into each other's
+    leap-gap inference."""
+    if isinstance(tick_rows, dict):
+        events = []
+        for i, (label, rows) in enumerate(tick_rows.items(), start=1):
+            events.extend(chrome_trace_events(rows, pid=i, label=str(label)))
+    else:
+        events = chrome_trace_events(tick_rows)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"schema": "kaboodle-telemetry/1", **(metadata or {})},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)

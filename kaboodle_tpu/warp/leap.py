@@ -133,6 +133,9 @@ def make_leap_fn(
     def pin(x: jax.Array) -> jax.Array:
         return constrain(x) if constrain is not None else x
 
+    # Named scope: labels the leap's ops in jax.profiler captures (metadata
+    # only — numerics and compiled-program identity are unchanged).
+    @jax.named_scope("kaboodle:leap")
     def leap(st: MeshState) -> MeshState:  # graftlint: traced
         n = st.state.shape[-1]
         n_cand = min(kk, n)

@@ -60,12 +60,17 @@ from kaboodle_tpu.warp.leap import make_leap_fn
 
 
 @functools.lru_cache(maxsize=None)
-def _dense_tick(cfg: SwimConfig, faulty: bool, mesh=None):
+def _dense_tick(cfg: SwimConfig, faulty: bool, mesh=None, telemetry: bool = False):
     if mesh is None:
-        return jax.jit(make_tick_fn(cfg, faulty=faulty))
+        return jax.jit(make_tick_fn(cfg, faulty=faulty, telemetry=telemetry))
     from kaboodle_tpu.parallel.mesh import make_sharded_tick
 
-    return jax.jit(make_sharded_tick(cfg, mesh, faulty=faulty))
+    return jax.jit(make_sharded_tick(cfg, mesh, faulty=faulty, telemetry=telemetry))
+
+
+@functools.lru_cache(maxsize=None)
+def _alive_count():
+    return jax.jit(lambda st: jnp.sum(st.alive, dtype=jnp.int32))
 
 
 @functools.lru_cache(maxsize=None)
@@ -130,6 +135,7 @@ def simulate_warped(
     recheck_every: int = 16,
     mesh=None,
     on_boundary=None,
+    telemetry: bool = False,
 ):
     """Run a stacked ``[T]`` schedule, fast-forwarding quiescent spans.
 
@@ -147,14 +153,29 @@ def simulate_warped(
     state)``, when given, is called at each leap's entry and exit boundary
     with the tick index about to run / just reached — the hook the parity
     fuzz uses to pin state equality at every event-horizon boundary.
+
+    ``telemetry=True`` runs the telemetry-plane dense tick and returns a
+    4-tuple ``(final_state, dense_ticks, dense_telemetry, totals)``:
+    ``dense_telemetry`` is the densely-executed ticks' stacked
+    ``TickTelemetry`` (``None`` if everything leaped) and ``totals`` the
+    whole run's ``ProtocolCounters`` sums — dense counters summed plus each
+    leaped span's closed form (``telemetry.counters.leap_counters``:
+    ``k * n_alive`` pings/acks, all else zero — what the dense kernel
+    provably emits on quiescent ticks, pinned by the warp counter-parity
+    fuzz arm). One extra scalar fetch per leap span (``n_alive``), in
+    keeping with the runner's one-fetch-per-span budget.
     """
+    from kaboodle_tpu.telemetry.counters import counters_totals, leap_counters
+    from kaboodle_tpu.telemetry.trace import host_span
+
     T = int(np.asarray(inputs.kill).shape[0])
     eventful = static_event_ticks(inputs)
-    tick = _dense_tick(cfg, faulty, mesh)
+    tick = _dense_tick(cfg, faulty, mesh, telemetry)
     quiescent = make_quiescence_fn(cfg)
     recheck_every = max(1, int(recheck_every))
     dense_ticks: list[int] = []
     metrics = []
+    leap_spans: list[tuple[int, int]] = []  # (span length, n_alive)
     t = 0
     while t < T:
         if not eventful[t]:
@@ -162,7 +183,12 @@ def simulate_warped(
             if bool(quiescent(state)):
                 if on_boundary is not None:
                     on_boundary(t, state)
-                state = _leap_span(state, cfg, span_end - t, mesh)
+                if telemetry:
+                    leap_spans.append(
+                        (span_end - t, int(_alive_count()(state)))
+                    )
+                with host_span(f"leap_span:{span_end - t}"):
+                    state = _leap_span(state, cfg, span_end - t, mesh)
                 t = span_end
                 if on_boundary is not None:
                     on_boundary(t, state)
@@ -170,15 +196,26 @@ def simulate_warped(
             stop = min(span_end, t + recheck_every)
         else:
             stop = t + 1
-        while t < stop:
-            state, m = tick(state, _slice_tick(inputs, t))
-            dense_ticks.append(t)
-            metrics.append(m)
-            t += 1
+        with host_span("dense_span"):
+            while t < stop:
+                state, m = tick(state, _slice_tick(inputs, t))
+                dense_ticks.append(t)
+                metrics.append(m)
+                t += 1
     stacked = (
         jax.tree.map(lambda *xs: jnp.stack(xs), *metrics) if metrics else None
     )
-    return state, np.asarray(dense_ticks, dtype=np.int32), stacked
+    if not telemetry:
+        return state, np.asarray(dense_ticks, dtype=np.int32), stacked
+    totals = (
+        counters_totals(stacked.counters)
+        if stacked is not None
+        else counters_totals(leap_counters(0, 0))
+    )
+    for k, n_alive in leap_spans:
+        leap = counters_totals(leap_counters(n_alive, k))
+        totals = {name: totals[name] + leap[name] for name in totals}
+    return state, np.asarray(dense_ticks, dtype=np.int32), stacked, totals
 
 
 def run_warped(

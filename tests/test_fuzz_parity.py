@@ -240,6 +240,191 @@ def test_recompile_counter_zero_after_warmup(seed):
     )
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_random_scenario_counter_parity(seed):
+    """ISSUE 6 counter-parity arm: the telemetry ProtocolCounters of the
+    dense kernel AND the chunked twin equal the lockstep oracle's per-tick
+    tallies bit-exactly, field by field, on random scenarios x random
+    flags. The oracle counts events from its message lists (host Python);
+    the kernels count them as pure tensor reductions — agreement means the
+    counter definitions name real protocol events, not kernel artifacts."""
+    import jax
+
+    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(8, 20))
+    n += n % 2  # even, so the chunked block = n // 2 divides
+    cfg = _random_cfg(rng)
+    ring = int(rng.integers(1, 3)) if not cfg.join_broadcast_enabled else 0
+    timer_dtype = jnp.int16 if rng.integers(2) else jnp.int32
+    st = init_state(n, seed=seed, ring_contacts=ring, timer_dtype=timer_dtype)
+    mesh = LockstepMesh(n, cfg, seed=seed, ring_contacts=ring)
+    tick_d = jax.jit(make_tick_fn(cfg, faulty=True, telemetry=True))
+    tick_c = jax.jit(
+        make_chunked_tick_fn(cfg, faulty=True, block=n // 2, telemetry=True)
+    )
+    sd = sc = st
+    for i, inp in enumerate(_random_inputs(rng, n, TICKS)):
+        for p in np.nonzero(np.asarray(inp.kill))[0]:
+            mesh.kill(int(p))
+        for p in np.nonzero(np.asarray(inp.revive))[0]:
+            mesh.revive(int(p))
+        manual = np.asarray(inp.manual_target)
+        for p in np.nonzero(manual >= 0)[0]:
+            mesh.engines[p].pending_manual_pings.append(int(manual[p]))
+        dok = np.asarray(inp.drop_ok)
+        part = np.asarray(inp.partition)
+        mesh.delivery_ok = lambda s, r, t, dok=dok, part=part: bool(
+            dok[s, r] and part[s] == part[r]
+        )
+        mesh.tick()
+        sd, out_d = tick_d(sd, inp)
+        sc, out_c = tick_c(sc, inp)
+        from kaboodle_tpu.telemetry.counters import FIELDS
+
+        oracle = mesh.last_tick_counters
+        assert set(oracle) == set(FIELDS)
+        for name, want in oracle.items():
+            dv = int(np.asarray(getattr(out_d.counters, name)))
+            cv = int(np.asarray(getattr(out_c.counters, name)))
+            assert dv == want, (
+                f"dense {name}={dv} != oracle {want} at tick {i} (seed {seed})"
+            )
+            assert cv == want, (
+                f"chunked {name}={cv} != oracle {want} at tick {i} (seed {seed})"
+            )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_sparse_schedule_warp_counter_totals(seed):
+    """The warp arm of the counter-parity fuzz: a telemetry warped run's
+    counter TOTALS (dense ticks measured + leaped spans' closed form)
+    equal the dense telemetry scan's summed counters on random sparse
+    schedules — i.e. ``leap_counters``' claim that a quiescent tick emits
+    exactly n_alive pings/acks and nothing else is bit-true."""
+    import jax
+
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs
+    from kaboodle_tpu.telemetry.counters import add_counters, counters_totals
+    from kaboodle_tpu.warp.runner import simulate_warped
+
+    rng = np.random.default_rng(4000 + seed)
+    n = int(rng.integers(10, 24))
+    ticks = int(rng.integers(24, 48))
+    cfg = SwimConfig(deterministic=bool(rng.integers(2)))
+    st = init_state(n, seed=seed, ring_contacts=n - 1, announced=True)
+
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    manual = np.full((ticks, n), -1, dtype=np.int32)
+    for t in sorted(rng.choice(ticks, size=3, replace=False)):
+        if rng.integers(2):
+            kill[t, rng.integers(n)] = True
+        else:
+            manual[t, rng.integers(n)] = int(rng.integers(n))
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=idle.revive,
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=jnp.asarray(manual),
+        drop_ok=None,
+    )
+
+    tick = jax.jit(make_tick_fn(cfg, faulty=True, telemetry=True))
+    sd, tot = st, None
+    for t in range(ticks):
+        sd, out = tick(sd, jax.tree.map(lambda x: x[t], inputs))
+        tot = out.counters if tot is None else add_counters(tot, out.counters)
+    dense_totals = counters_totals(tot)
+
+    wf, dense_ticks, _, warp_totals = simulate_warped(
+        st, inputs, cfg, faulty=True, recheck_every=4, telemetry=True
+    )
+    assert warp_totals == dense_totals, (
+        f"warp totals diverge (seed {seed}, "
+        f"{int(dense_ticks.size)}/{ticks} dense): "
+        f"{warp_totals} != {dense_totals}"
+    )
+    for x, y in zip(jax.tree.leaves(sd), jax.tree.leaves(wf)):
+        xv, yv = np.asarray(x), np.asarray(y)
+        if xv.dtype == np.float32:
+            assert ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+        else:
+            assert (xv == yv).all()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_recompile_counter_zero_after_warmup_telemetry(seed):
+    """The zero-recompile arm with the telemetry plane ON (ISSUE 6): a
+    warmed telemetry-enabled run — counter scan + flight recorder through
+    the runner, plus a telemetry warped run — triggers ZERO fresh compiles
+    on re-dispatch. The recorder ring rides the carry with fixed shapes
+    and the counters are added outputs of the same program, so telemetry
+    must not cost a single extra compilation after warmup."""
+    import jax
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.sim.runner import (
+        run_until_converged_telemetry,
+        simulate_with_telemetry,
+    )
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs
+    from kaboodle_tpu.warp.runner import simulate_warped
+
+    assert_counter_live()
+
+    rng = np.random.default_rng(6000 + seed)
+    n = int(rng.integers(12, 20))
+    ticks = 64
+    cfg = SwimConfig(deterministic=bool(rng.integers(2)))
+    st = init_state(n, seed=seed, ring_contacts=n - 1, announced=True)
+
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    manual = np.full((ticks, n), -1, dtype=np.int32)
+    for t in sorted(rng.choice(ticks, size=3, replace=False)):
+        if rng.integers(2):
+            kill[t, rng.integers(n)] = True
+        else:
+            manual[t, rng.integers(n)] = int(rng.integers(n))
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=idle.revive,
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=jnp.asarray(manual),
+        drop_ok=None,
+    )
+
+    sim = jax.jit(
+        lambda s, i: simulate_with_telemetry(s, i, cfg, recorder_len=8)
+    )
+
+    # --- warm-up: every telemetry program once ----------------------------
+    jax.block_until_ready(sim(st, inputs)[0])
+    run_until_converged_telemetry(st, cfg, max_ticks=16, recorder_len=8)
+    simulate_warped(st, inputs, cfg, faulty=True, recheck_every=8,
+                    telemetry=True)
+
+    st_b = init_state(n, seed=seed + 17, ring_contacts=n - 1, announced=True)
+    with compile_counter() as box:
+        jax.block_until_ready(sim(st_b, inputs)[0])
+        run_until_converged_telemetry(st_b, cfg, max_ticks=16, recorder_len=8)
+        simulate_warped(st, inputs, cfg, faulty=True, recheck_every=8,
+                        telemetry=True)
+    assert box.count == 0, (
+        f"{box.count} fresh compiles in a warmed telemetry-enabled run "
+        f"(seed {seed}) — the telemetry plane broke memoization"
+    )
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_random_scenario_chunked_third_engine(seed):
     """The chunked (row-blocked) kernel as a third arm of the same fuzz:

@@ -231,3 +231,31 @@ def test_sharded_convergence_check_matches_tick(mesh8):
     )
     conv, *_ = sharded_convergence_check(st)
     assert bool(conv)
+
+
+@pytest.mark.slow
+def test_sharded_telemetry_counters_match_dense(mesh8):
+    """The telemetry build of the sharded tick (ISSUE 6): GSPMD partitioning
+    must not change a single counter — per-tick ProtocolCounters and the fp
+    digest plane equal the single-device telemetry tick's bit-for-bit, and
+    the carried state stays equal too."""
+    from kaboodle_tpu.parallel import make_sharded_tick
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.telemetry.counters import FIELDS
+
+    n = 32
+    cfg = SwimConfig(deterministic=True)
+    st = init_state(n, seed=5)
+    dense = jax.jit(make_tick_fn(cfg, faulty=True, telemetry=True))
+    sharded = jax.jit(make_sharded_tick(cfg, mesh8, faulty=True, telemetry=True))
+    sa, sb = st, shard_state(st, mesh8)
+    for _ in range(6):
+        inp = idle_inputs(n)
+        sa, out_a = dense(sa, inp)
+        sb, out_b = sharded(sb, inp)
+        _assert_states_equal(sa, sb)
+        for name in FIELDS:
+            assert int(jnp.asarray(getattr(out_a.counters, name))) == int(
+                jnp.asarray(getattr(out_b.counters, name))
+            ), name
+        assert jnp.array_equal(out_a.fp, out_b.fp)

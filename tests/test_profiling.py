@@ -46,3 +46,82 @@ def test_trace_captures_profile(tmp_path):
     # The JAX profiler writes its plugin tree under the log dir.
     captured = list(tmp_path.rglob("*"))
     assert captured, "profiler trace produced no files"
+
+
+# ---- warp_stats / warp_summary degenerate shapes (ISSUE 6 satellite) ------
+# An already-converged entry state leaps the whole schedule: zero dense
+# ticks, ``metrics is None``. Every ratio-style stat must survive that
+# without a ZeroDivisionError or a NaN row.
+
+
+def test_warp_stats_all_leaped_is_empty_table():
+    from kaboodle_tpu.profiling import warp_stats
+
+    table = warp_stats(np.zeros((0,), np.int32), None)
+    assert table.shape == (0,)
+    assert "messages_delivered" in table.dtype.names
+
+
+def test_warp_stats_rewrites_tick_column():
+    from kaboodle_tpu.sim.state import TickMetrics
+    from kaboodle_tpu.profiling import warp_stats
+
+    m = TickMetrics(
+        messages_delivered=np.asarray([3, 4], np.int32),
+        converged=np.asarray([False, True]),
+        agree_fraction=np.asarray([0.5, 1.0], np.float32),
+        mean_membership=np.asarray([2.0, 2.0], np.float32),
+        fingerprint_min=np.asarray([1, 2], np.uint32),
+        fingerprint_max=np.asarray([9, 2], np.uint32),
+    )
+    table = warp_stats(np.asarray([7, 19], np.int32), m)
+    np.testing.assert_array_equal(table["tick"], [7, 19])
+    np.testing.assert_array_equal(table["messages_delivered"], [3, 4])
+
+
+def test_warp_summary_all_leaped():
+    from kaboodle_tpu.profiling import warp_summary
+
+    s = warp_summary(np.zeros((0,), np.int32), 64, None)
+    assert s["dense_ticks"] == 0 and s["leaped_ticks"] == 64
+    assert s["dense_fraction"] == 0.0 and s["leaped_fraction"] == 1.0
+    assert s["mean_msgs_per_dense_tick"] == 0.0
+
+
+def test_warp_summary_zero_tick_run():
+    from kaboodle_tpu.profiling import warp_summary
+
+    s = warp_summary(np.zeros((0,), np.int32), 0, None)
+    assert s["total_ticks"] == 0
+    assert s["dense_fraction"] == 0.0 and s["leaped_fraction"] == 0.0
+
+
+def test_warp_summary_rejects_impossible_counts():
+    from kaboodle_tpu.profiling import warp_summary
+
+    with pytest.raises(ValueError):
+        warp_summary(np.arange(4), 2, None)
+
+
+@pytest.mark.slow
+def test_warp_summary_matches_warped_run():
+    from kaboodle_tpu.profiling import warp_summary
+    from kaboodle_tpu.sim.state import init_state
+    from kaboodle_tpu.warp.runner import simulate_warped
+
+    n, ticks = 12, 24
+    st = init_state(n, seed=0, ring_contacts=n - 1, announced=True)
+    sc_inputs = idle_inputs(n, ticks=ticks)
+    import dataclasses
+
+    sc_inputs = dataclasses.replace(
+        sc_inputs, manual_target=sc_inputs.manual_target.at[10, 0].set(3)
+    )
+    _, dense_ticks, m = simulate_warped(
+        st, sc_inputs, SwimConfig(), faulty=True, recheck_every=4
+    )
+    s = warp_summary(dense_ticks, ticks, m)
+    assert s["dense_ticks"] == int(dense_ticks.size)
+    assert s["leaped_ticks"] == ticks - int(dense_ticks.size)
+    assert 0.0 < s["dense_fraction"] < 1.0
+    assert s["messages_delivered"] == int(np.asarray(m.messages_delivered).sum())

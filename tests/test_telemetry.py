@@ -1,0 +1,529 @@
+"""The telemetry plane: counters, flight recorder, manifests, trace export.
+
+Cross-ENGINE counter parity (kernel == chunked == oracle, warp totals) lives
+in tests/test_fuzz_parity.py with the other randomized arms; this file pins
+the telemetry plane's own contracts — the pure-derived-values guarantee
+(state bit-identical with telemetry on or off), the ring-buffer mechanics,
+the manifest schema, and the exporters/summarizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.sim.kernel import make_tick_fn
+from kaboodle_tpu.sim.state import TickMetrics, idle_inputs, init_state
+from kaboodle_tpu.telemetry import (
+    RECORD_BYTES,
+    ManifestWriter,
+    ProtocolCounters,
+    add_counters,
+    chrome_trace_events,
+    counters_table,
+    counters_totals,
+    init_recorder,
+    leap_counters,
+    read_manifest,
+    record_tick,
+    recorder_rows,
+    run_record,
+    scale_counters,
+    validate_record,
+    write_chrome_trace,
+    zero_counters,
+)
+from kaboodle_tpu.telemetry.counters import FIELDS, TickTelemetry
+
+CFG = SwimConfig()
+
+
+# ---- counters helpers ------------------------------------------------------
+
+
+def test_zero_counters_dtypes():
+    z = zero_counters()
+    for name in FIELDS:
+        leaf = getattr(z, name)
+        want = jnp.uint32 if name == "gossip_bytes" else jnp.int32
+        assert leaf.dtype == want, name
+        assert int(leaf) == 0
+
+
+def test_add_and_scale_counters():
+    a = dataclasses.replace(zero_counters(), pings_sent=jnp.int32(3))
+    b = dataclasses.replace(zero_counters(), pings_sent=jnp.int32(4),
+                            acks_sent=jnp.int32(1))
+    s = add_counters(a, b)
+    assert int(s.pings_sent) == 7 and int(s.acks_sent) == 1
+    k = scale_counters(b, 5)
+    assert int(k.pings_sent) == 20 and int(k.acks_sent) == 5
+    assert k.gossip_bytes.dtype == jnp.uint32
+
+
+def test_leap_counters_closed_form():
+    c = leap_counters(n_alive=12, k=7)
+    t = counters_totals(c)
+    assert t["pings_sent"] == 84 and t["acks_sent"] == 84
+    assert all(
+        v == 0 for name, v in t.items() if name not in ("pings_sent", "acks_sent")
+    )
+
+
+def test_counters_table_layout():
+    stacked = jax.tree.map(
+        lambda x: jnp.stack([x, x + 2]), zero_counters()
+    )
+    table = counters_table(stacked)
+    assert table.shape == (2,)
+    np.testing.assert_array_equal(table["tick"], [0, 1])
+    assert table["gossip_bytes"].dtype == np.uint32
+    np.testing.assert_array_equal(table["pings_sent"], [0, 2])
+
+
+# ---- the pure-derived-values contract --------------------------------------
+
+
+@pytest.mark.slow
+def test_state_trajectory_identical_with_telemetry_on():
+    """telemetry=True only ADDS outputs: states and metrics are bit-equal
+    to the plain build's every tick, and the fp digest plane equals the
+    metrics' min/max envelope."""
+    n = 12
+    plain = jax.jit(make_tick_fn(CFG, faulty=True))
+    telem = jax.jit(make_tick_fn(CFG, faulty=True, telemetry=True))
+    sa = sb = init_state(n, seed=3)
+    rng = np.random.default_rng(0)
+    for t in range(8):
+        kill = rng.random(n) < 0.1
+        inp = dataclasses.replace(
+            idle_inputs(n), kill=jnp.asarray(kill)
+        )
+        sa, m = plain(sa, inp)
+        sb, out = telem(sb, inp)
+        assert isinstance(out, TickTelemetry)
+        for x, y in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+            xv, yv = np.asarray(x), np.asarray(y)
+            if xv.dtype == np.float32:
+                assert ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+            else:
+                assert (xv == yv).all()
+        for x, y in zip(jax.tree.leaves(m), jax.tree.leaves(out.metrics)):
+            assert (np.asarray(x) == np.asarray(y)).all()
+        fp = np.asarray(out.fp)
+        alive = np.asarray(sb.alive)
+        assert fp.dtype == np.uint32 and fp.shape == (n,)
+        assert fp[alive].min() == int(np.asarray(out.metrics.fingerprint_min))
+        assert fp[alive].max() == int(np.asarray(out.metrics.fingerprint_max))
+
+
+def test_telemetry_rejects_cut_probe():
+    with pytest.raises(ValueError, match="_cut"):
+        make_tick_fn(CFG, telemetry=True, _cut="A")
+
+
+# ---- flight recorder -------------------------------------------------------
+
+
+def _fake_out(msgs: int, tick: int) -> TickTelemetry:
+    return TickTelemetry(
+        metrics=TickMetrics(
+            messages_delivered=jnp.int32(msgs),
+            converged=jnp.asarray(tick % 2 == 0),
+            agree_fraction=jnp.float32(1.0),
+            mean_membership=jnp.float32(4.0),
+            fingerprint_min=jnp.uint32(tick),
+            fingerprint_max=jnp.uint32(tick + 1),
+        ),
+        counters=dataclasses.replace(
+            zero_counters(), pings_sent=jnp.int32(msgs)
+        ),
+        fp=jnp.full((4,), tick, jnp.uint32),
+    )
+
+
+def test_recorder_partial_fill():
+    rec = init_recorder(8, 4)
+    for t in range(3):
+        rec = record_tick(rec, t, _fake_out(10 + t, t))
+    rows = recorder_rows(rec)
+    assert rows["table"].shape == (3,)
+    np.testing.assert_array_equal(rows["table"]["tick"], [0, 1, 2])
+    np.testing.assert_array_equal(rows["table"]["pings_sent"], [10, 11, 12])
+    assert rows["fp"].shape == (3, 4)
+
+
+def test_recorder_ring_wraparound():
+    """Writing 11 ticks into a 4-slot ring keeps exactly the last 4, in
+    chronological order."""
+    rec = init_recorder(4, 4)
+    record = jax.jit(record_tick)
+    for t in range(11):
+        rec = record(rec, t, _fake_out(100 + t, t))
+    rows = recorder_rows(rec)
+    np.testing.assert_array_equal(rows["table"]["tick"], [7, 8, 9, 10])
+    np.testing.assert_array_equal(
+        rows["table"]["pings_sent"], [107, 108, 109, 110]
+    )
+    np.testing.assert_array_equal(rows["fp"][:, 0], [7, 8, 9, 10])
+    assert int(rec.head) == 11
+
+
+def test_recorder_rejects_zero_capacity():
+    with pytest.raises(ValueError):
+        init_recorder(0, 4)
+
+
+@pytest.mark.slow
+def test_run_until_converged_telemetry_matches_plain():
+    from kaboodle_tpu.sim.runner import (
+        run_until_converged,
+        run_until_converged_telemetry,
+    )
+
+    n = 12
+    st = init_state(n, seed=1)
+    s0, t0, c0 = run_until_converged(st, CFG, max_ticks=32)
+    s1, t1, c1, totals, rec = run_until_converged_telemetry(
+        st, CFG, max_ticks=32, recorder_len=8
+    )
+    assert int(t0) == int(t1) and bool(c0) == bool(c1)
+    for x, y in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        xv, yv = np.asarray(x), np.asarray(y)
+        if xv.dtype == np.float32:
+            assert ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+        else:
+            assert (xv == yv).all()
+    rows = recorder_rows(rec)
+    assert rows["table"].shape[0] == min(int(t1), 8)
+    if rows["table"].shape[0]:
+        assert bool(rows["table"]["converged"][-1]) == bool(c1)
+    # Entry agreement short-circuits at zero ticks: recorder stays empty,
+    # totals stay zero (the zero-denominator regime profiling guards).
+    s2, t2, c2, totals2, rec2 = run_until_converged_telemetry(
+        s1, CFG, max_ticks=32, recorder_len=8
+    )
+    assert int(t2) == 0 and bool(c2)
+    assert recorder_rows(rec2)["table"].shape[0] == 0
+    assert all(v == 0 for v in counters_totals(totals2).values())
+
+
+@pytest.mark.slow
+def test_simulate_with_telemetry_counts_and_recorder_agree():
+    from kaboodle_tpu.sim.runner import simulate_with_telemetry
+
+    n, ticks, k = 10, 9, 4
+    st = init_state(n, seed=2)
+    final, metrics, counters, rec = simulate_with_telemetry(
+        st, idle_inputs(n, ticks=ticks), CFG, recorder_len=k
+    )
+    assert np.asarray(counters.pings_sent).shape == (ticks,)
+    rows = recorder_rows(rec)
+    np.testing.assert_array_equal(
+        rows["table"]["tick"], np.arange(ticks - k, ticks)
+    )
+    # Ring slots hold exactly the stacked counters' tail rows.
+    table = counters_table(counters)
+    for name in FIELDS:
+        np.testing.assert_array_equal(
+            rows["table"][name], table[name][ticks - k:], err_msg=name
+        )
+
+
+# ---- manifests -------------------------------------------------------------
+
+
+def test_run_record_and_validate():
+    rec = run_record("run", metric="x", value=np.int32(3),
+                     arr=np.arange(2, dtype=np.uint32))
+    assert validate_record(rec) is rec
+    assert rec["value"] == 3 and rec["arr"] == [0, 1]
+    json.dumps(rec)  # JSON-serializable end to end
+    with pytest.raises(ValueError, match="schema"):
+        validate_record({"kind": "run"})
+    with pytest.raises(ValueError, match="kind"):
+        validate_record({"schema": "kaboodle-telemetry/1"})
+    with pytest.raises(ValueError, match="tick"):
+        validate_record(
+            {"schema": "kaboodle-telemetry/1", "kind": "tick", "tick": "no"}
+        )
+
+
+def test_manifest_writer_roundtrip(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with ManifestWriter(path) as w:
+        w.write("run", metric="t", value=1)
+        w.write("tick", tick=0, pings_sent=4)
+        assert w.records_written == 2
+    recs = list(read_manifest(path))
+    assert [r["kind"] for r in recs] == ["run", "tick"]
+    # Default mode REPLACES: re-running a lane with the same path must not
+    # merge two runs (doubled totals, duplicate ticks).
+    with ManifestWriter(path) as w:
+        w.write("tick", tick=1)
+    assert [r["tick"] for r in read_manifest(path)] == [1]
+    # append=True opts into accumulation (bench.py --manifest).
+    with ManifestWriter(path, append=True) as w:
+        w.write("tick", tick=2)
+    assert [r["tick"] for r in read_manifest(path)] == [1, 2]
+
+
+def test_read_manifest_rejects_garbage(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"schema": "nope", "kind": "run"}\n')
+    with pytest.raises(ValueError, match="schema"):
+        list(read_manifest(str(path)))
+    path.write_text("not json\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        list(read_manifest(str(path)))
+
+
+def test_write_tick_metrics_zero_ticks_is_empty(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    empty = TickMetrics(
+        messages_delivered=np.zeros((0,), np.int32),
+        converged=np.zeros((0,), bool),
+        agree_fraction=np.zeros((0,), np.float32),
+        mean_membership=np.zeros((0,), np.float32),
+        fingerprint_min=np.zeros((0,), np.uint32),
+        fingerprint_max=np.zeros((0,), np.uint32),
+    )
+    with ManifestWriter(path) as w:
+        assert w.write_tick_metrics(empty) == 0
+    assert list(read_manifest(path)) == []
+
+
+def test_write_tick_metrics_with_counters_and_tick_override(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    m = TickMetrics(
+        messages_delivered=np.asarray([5, 6], np.int32),
+        converged=np.asarray([False, True]),
+        agree_fraction=np.asarray([0.5, 1.0], np.float32),
+        mean_membership=np.asarray([3.0, 3.0], np.float32),
+        fingerprint_min=np.asarray([1, 2], np.uint32),
+        fingerprint_max=np.asarray([8, 2], np.uint32),
+    )
+    counters = jax.tree.map(
+        lambda x: jnp.stack([x + 1, x + 2]), zero_counters()
+    )
+    with ManifestWriter(path) as w:
+        w.write_tick_metrics(m, counters=counters, ticks=np.asarray([4, 9]))
+    recs = list(read_manifest(path))
+    assert [r["tick"] for r in recs] == [4, 9]
+    assert recs[0]["pings_sent"] == 1 and recs[1]["pings_sent"] == 2
+    assert recs[1]["converged"] is True
+
+
+def test_write_recorder_record(tmp_path):
+    rec = init_recorder(3, 4)
+    for t in range(5):
+        rec = record_tick(rec, t, _fake_out(20 + t, t))
+    path = str(tmp_path / "m.jsonl")
+    with ManifestWriter(path) as w:
+        w.write_recorder(rec)
+    (r,) = list(read_manifest(path))
+    assert r["kind"] == "recorder"
+    assert [row["tick"] for row in r["rows"]] == [2, 3, 4]
+    assert len(r["fp_unique"]) == 3
+
+
+# ---- trace export ----------------------------------------------------------
+
+
+def test_chrome_trace_leap_gap_and_counters():
+    rows = [
+        {"tick": 0, "pings_sent": 4, "converged": False},
+        {"tick": 1, "pings_sent": 4, "converged": True},
+        # ticks 2..9 leaped
+        {"tick": 10, "pings_sent": 5, "converged": True},
+    ]
+    events = chrome_trace_events(rows)
+    leaps = [e for e in events if e["name"] == "leap"]
+    assert len(leaps) == 1
+    assert leaps[0]["ts"] == 2 * 1000 and leaps[0]["dur"] == 8 * 1000
+    assert leaps[0]["args"]["leaped_ticks"] == 8
+    ticks = [e for e in events if e["name"] == "tick"]
+    assert len(ticks) == 3
+    series = [e for e in events if e["name"] == "pings_sent" and e["ph"] == "C"]
+    assert [e["args"]["pings_sent"] for e in series] == [4, 4, 5]
+
+
+def test_write_chrome_trace_loads_as_json(tmp_path):
+    path = str(tmp_path / "trace.json")
+    n = write_chrome_trace(path, [{"tick": 0, "acks_sent": 1}],
+                           metadata={"lane": "test"})
+    with open(path) as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == n
+    assert doc["otherData"]["lane"] == "test"
+
+
+def test_write_chrome_trace_groups_keep_runs_on_separate_tracks(tmp_path):
+    """A {label: rows} mapping puts each run on its own pid, so one run's
+    ticks can neither overlap another's slices nor mask its leap gaps."""
+    path = str(tmp_path / "trace.json")
+    dense = [{"tick": t, "pings_sent": 8} for t in range(4)]
+    warped = [{"tick": 0, "pings_sent": 8}, {"tick": 10, "pings_sent": 8}]
+    write_chrome_trace(path, {"dense.jsonl": dense, "warp.jsonl": warped})
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    pids = {e["pid"] for e in events}
+    assert pids == {1, 2}
+    leaps = [e for e in events if e["name"] == "leap"]
+    # Only the warped run has a gap — and it survives the dense run's
+    # presence (pooled onto one track, dense ticks 1..3 would mask it).
+    assert len(leaps) == 1 and leaps[0]["pid"] == 2
+    assert leaps[0]["args"]["leaped_ticks"] == 9
+    names = {e["args"]["name"] for e in events if e["name"] == "process_name"}
+    assert names == {"dense.jsonl", "warp.jsonl"}
+
+
+# ---- summarizer CLI --------------------------------------------------------
+
+
+def _write_sample_manifest(path: str) -> None:
+    with ManifestWriter(path) as w:
+        w.write("run", metric="sim_run", n_peers=8, ticks=3, wall_s=0.1)
+        for t in range(3):
+            w.write("tick", tick=t, pings_sent=8, acks_sent=8,
+                    converged=t > 0)
+
+
+def test_summary_main_summarizes_and_exports(tmp_path, capsys):
+    from kaboodle_tpu.telemetry.summary import main
+
+    mpath = str(tmp_path / "m.jsonl")
+    tpath = str(tmp_path / "t.json")
+    _write_sample_manifest(mpath)
+    assert main([mpath, "--trace", tpath, "--check"]) == 0
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["records"] == 4
+    assert tail["counter_totals"]["pings_sent"] == 24
+    assert tail["first_converged_tick"] == 1
+    assert tail["final_converged"] is True
+    with open(tpath) as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_summary_main_check_fails_on_empty_and_invalid(tmp_path, capsys):
+    from kaboodle_tpu.telemetry.summary import main
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main([str(empty), "--check"]) == 1
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"schema": "wrong/9", "kind": "run"}\n')
+    assert main([str(bad)]) == 1
+
+
+def test_cli_dispatches_telemetry_subcommand(tmp_path, capsys):
+    from kaboodle_tpu.cli import main
+
+    mpath = str(tmp_path / "m.jsonl")
+    _write_sample_manifest(mpath)
+    assert main(["telemetry", mpath]) == 0
+    assert "telemetry:" in capsys.readouterr().out
+
+
+# ---- CLI sim lanes ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_sim_telemetry_and_metrics_jsonl(tmp_path, capsys):
+    from kaboodle_tpu.cli import main
+
+    tpath = str(tmp_path / "run.jsonl")
+    mpath = str(tmp_path / "metrics.jsonl")
+    assert main(["--sim", "8", "--ticks", "4", "--telemetry", tpath,
+                 "--metrics-jsonl", mpath]) == 0
+    tail = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "counter_totals" in tail
+    recs = list(read_manifest(tpath))
+    kinds = {r["kind"] for r in recs}
+    assert {"run", "tick", "recorder"} <= kinds
+    ticks = [r for r in recs if r["kind"] == "tick"]
+    assert len(ticks) == 4 and "pings_sent" in ticks[0]
+    assert tail["counter_totals"]["pings_sent"] == sum(
+        r["pings_sent"] for r in ticks
+    )
+    mrecs = list(read_manifest(mpath))
+    assert len(mrecs) == 4 and "pings_sent" not in mrecs[0]
+    assert "messages_delivered" in mrecs[0]
+
+
+@pytest.mark.slow
+def test_cli_sim_warp_telemetry(tmp_path, capsys):
+    from kaboodle_tpu.cli import main
+
+    tpath = str(tmp_path / "warp.jsonl")
+    assert main(["--sim", "8", "--ticks", "24", "--warp",
+                 "--telemetry", tpath]) == 0
+    tail = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "counter_totals" in tail
+    recs = list(read_manifest(tpath))
+    runs = [r for r in recs if r["kind"] == "run"]
+    assert runs and runs[0]["warp"] is True
+    # The boot isn't quiescent at tick 0, so some dense ticks exist; their
+    # manifest rows carry the REAL tick indices (gaps = leaped spans).
+    ticks = [r["tick"] for r in recs if r["kind"] == "tick"]
+    assert ticks == sorted(ticks)
+    assert runs[0]["counter_totals"]["pings_sent"] > 0
+
+
+# ---- fleet telemetry -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_member_counters_match_standalone():
+    """Member e of a telemetry fleet run carries bit-exactly the counters a
+    standalone telemetry run from the same seed produces (the vmap half of
+    the counter-parity contract)."""
+    from kaboodle_tpu.fleet.core import (
+        fleet_idle_inputs,
+        init_fleet,
+        member_state,
+        simulate_fleet,
+    )
+    from kaboodle_tpu.sim.runner import simulate_with_telemetry
+
+    n, e_n, ticks = 10, 3, 6
+    fleet = init_fleet(n, e_n)
+    f2, tel = simulate_fleet(
+        fleet, fleet_idle_inputs(n, e_n, ticks=ticks), CFG,
+        faulty=True, telemetry=True,
+    )
+    assert np.asarray(tel.counters.pings_sent).shape == (ticks, e_n)
+    assert np.asarray(tel.fp).shape == (ticks, e_n, n)
+    for e in range(e_n):
+        _, _, counters, _ = simulate_with_telemetry(
+            member_state(fleet, e), idle_inputs(n, ticks), CFG
+        )
+        for name in FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(tel.counters, name))[:, e],
+                np.asarray(getattr(counters, name)),
+                err_msg=f"member {e} {name}",
+            )
+
+
+# ---- counter dtype discipline ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_gossip_bytes_is_modular_uint32():
+    """RECORD_BYTES scaling stays in uint32 (the documented modular model)
+    and the emitted leaf is uint32 on a real tick."""
+    assert RECORD_BYTES == 8
+    tick = jax.jit(make_tick_fn(CFG, faulty=True, telemetry=True))
+    st = init_state(8, seed=0)
+    _, out = tick(st, idle_inputs(8))
+    assert out.counters.gossip_bytes.dtype == jnp.uint32
+    assert isinstance(out.counters, ProtocolCounters)

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun telemetry-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -61,6 +61,7 @@ ci: lint native test
 	$(MAKE) fleet-dryrun
 	$(MAKE) warp-dryrun
 	$(MAKE) telemetry-dryrun
+	$(MAKE) phasegraph-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -90,6 +91,17 @@ telemetry-dryrun:
 	$(PYTHON) -m kaboodle_tpu telemetry --check \
 	  --trace /tmp/kaboodle-telemetry-dryrun.trace.json \
 	  /tmp/kaboodle-telemetry-dryrun.jsonl /tmp/kaboodle-telemetry-dryrun-warp.jsonl
+
+# Phase-graph dryrun (kaboodle_tpu/phasegraph, ISSUE 7): build every
+# engine the planner derives from the one op graph — dense, standalone
+# fused, chunked, sharded, fleet, warp leap — at toy N, run real ticks,
+# and diff each bit-for-bit against the dense derivation (exit nonzero on
+# any mismatch). Logs the planned pass/prune tables too, so a CI run shows
+# the program shapes it just proved equal. The at-scale exactness pins
+# live in the parity suites; the measured fast-path numbers in
+# `python bench.py --fastpath-ab` (PERF.md "Phase graph").
+phasegraph-dryrun:
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu phasegraph
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

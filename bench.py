@@ -31,6 +31,16 @@ import sys
 import time
 
 
+def _leaf_equal(a, b) -> bool:
+    """The canonical A/B bit-exactness predicate, imported lazily (bench
+    must not import the package — and with it jax — before the platform is
+    pinned). Any state difference between arms voids a lane's measurement
+    before its number is reported."""
+    from kaboodle_tpu.profiling import leaf_equal
+
+    return leaf_equal(a, b)
+
+
 def _null_rtt() -> float:
     """Round-trip of a trivial jitted fetch (tunnel + dispatch overhead)."""
     import jax
@@ -256,7 +266,6 @@ def _bench_warp(n: int, ticks: int):
     """
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.sim.runner import simulate
@@ -297,12 +306,6 @@ def _bench_warp(n: int, ticks: int):
     jax.block_until_ready(out_w)
     warp_wall = max(time.perf_counter() - t0 - rtt, 1e-9)
 
-    def _leaf_equal(a, b):
-        av, bv = np.asarray(a), np.asarray(b)
-        if np.issubdtype(av.dtype, np.floating):  # latency plane carries NaNs
-            return bool(((av == bv) | (np.isnan(av) & np.isnan(bv))).all())
-        return bool((av == bv).all())
-
     bit_exact = all(
         _leaf_equal(a, b)
         for a, b in zip(jax.tree.leaves(out_d), jax.tree.leaves(out_w))
@@ -337,7 +340,6 @@ def _bench_telemetry_ab(n: int, ticks: int):
     """
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.sim.runner import simulate, simulate_with_telemetry
@@ -384,12 +386,6 @@ def _bench_telemetry_ab(n: int, ticks: int):
     off_wall = best_of(plain)
     on_wall = best_of(telem)
 
-    def _leaf_equal(a, b):
-        av, bv = np.asarray(a), np.asarray(b)
-        if np.issubdtype(av.dtype, np.floating):  # latency plane carries NaNs
-            return bool(((av == bv) | (np.isnan(av) & np.isnan(bv))).all())
-        return bool((av == bv).all())
-
     bit_exact = all(
         _leaf_equal(a, b)
         for a, b in zip(jax.tree.leaves(out_a[0]), jax.tree.leaves(out_b[0]))
@@ -401,6 +397,109 @@ def _bench_telemetry_ab(n: int, ticks: int):
         "telemetry_on_wall_s": round(on_wall, 4),
         "overhead_pct": round(100.0 * (on_wall / off_wall - 1.0), 2),
         "recorder_len": 32,
+        "bit_exact": bit_exact,
+        "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
+    }
+
+
+def _bench_fastpath_ab(n: int, ticks: int):
+    """A/B: the phase-graph fused fast path vs the pre-refactor full tick.
+
+    Three arms over the same converged steady-state scenario (two sparse
+    manual pings — the telemetry A/B's lane), all faulty builds:
+
+    - **full** — ``program="full"``: one pass per cond-gated phase, the
+      multi-pass shape every faulty tick ran before the phase graph (the
+      42-45 ms/tick @ N=16,384 bench-lane baseline in PERF.md round-4c).
+    - **dispatched** — the production build: per-tick ``lax.cond`` between
+      the full and fused programs on the planner-derived predicate.
+    - **fused** — the standalone 2-pass program (draw + folded update),
+      legal here because the steady lane keeps the dispatch predicate
+      false every tick.
+
+    All three final states AND per-tick metrics are compared bit-for-bit
+    BEFORE any number is reported (like ``--warp`` / ``--telemetry-ab``):
+    the dispatch contract says program choice never changes values, so any
+    difference voids the measurement. The headline is
+    ``speedup = full / dispatched`` — what production steady ticks gained —
+    with the standalone fused arm as the no-dispatch floor. The pass
+    structure behind the numbers rides along from the planner
+    (``passes_full`` / ``passes_fused`` / ``pruned``), so the JSON tail
+    documents WHY the fused arm is faster, not just that it is.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick, make_fused_tick
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+    from kaboodle_tpu.sim.scenario import Scenario
+    from kaboodle_tpu.sim.state import init_state
+
+    cfg = SwimConfig()
+    lean = n >= LEAN_STATE_MIN_N
+    narrow = lean and ticks <= 32000
+    st = init_state(n, seed=0, ring_contacts=n - 1, announced=True,
+                    track_latency=not lean, instant_identity=lean,
+                    timer_dtype=jnp.int16 if narrow else jnp.int32)
+    sc = Scenario(n, ticks, seed=0)
+    sc.manual_ping_at(ticks // 3, 0, 1)
+    sc.manual_ping_at((2 * ticks) // 3, 1, 2)
+    inputs = sc.build()
+    rtt = _null_rtt()
+
+    full_tick = make_tick_fn(cfg, faulty=True, program="full")
+    disp_tick = make_dense_tick(cfg, faulty=True)
+    fused_tick = make_fused_tick(cfg, faulty=True)
+
+    def compile_scan(tick):
+        return jax.jit(
+            lambda s, i: jax.lax.scan(tick, s, i)
+        ).lower(st, inputs).compile()
+
+    arms = {
+        "full": compile_scan(full_tick),
+        "dispatched": compile_scan(disp_tick),
+        "fused": compile_scan(fused_tick),
+    }
+
+    # Warm each arm once (doubles as the bit-exactness evidence), then best
+    # of three timed executions per arm, same discipline as --telemetry-ab.
+    outs = {}
+    for name, fn in arms.items():
+        outs[name] = fn(st, inputs)
+        jax.block_until_ready(outs[name])
+    ref = jax.tree.leaves(outs["full"])
+    bit_exact = all(
+        _leaf_equal(a, b)
+        for other in ("dispatched", "fused")
+        for a, b in zip(ref, jax.tree.leaves(outs[other]))
+    )
+
+    def best_of(fn, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(st, inputs))
+            best = min(best, time.perf_counter() - t0 - rtt)
+        return max(best, 1e-9)
+
+    walls = {name: best_of(fn) for name, fn in arms.items()}
+    progs = disp_tick.programs
+    return {
+        "n": n,
+        "ticks": ticks,
+        "full_wall_s": round(walls["full"], 4),
+        "dispatched_wall_s": round(walls["dispatched"], 4),
+        "fused_wall_s": round(walls["fused"], 4),
+        "full_ms_per_tick": round(1e3 * walls["full"] / ticks, 3),
+        "dispatched_ms_per_tick": round(1e3 * walls["dispatched"] / ticks, 3),
+        "fused_ms_per_tick": round(1e3 * walls["fused"] / ticks, 3),
+        "speedup": round(walls["full"] / walls["dispatched"], 2),
+        "fused_speedup": round(walls["full"] / walls["fused"], 2),
+        "passes_full": len(progs["full"].passes),
+        "passes_fused": len(progs["fused"].passes),
+        "pruned": [name for name, _ in progs["fused"].pruned],
         "bit_exact": bit_exact,
         "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
     }
@@ -806,6 +905,12 @@ def main() -> None:
                         "telemetry counter+recorder plane on the steady-state "
                         "scan) instead of the standard sections; same JSON "
                         "tail contract")
+    p.add_argument("--fastpath-ab", action="store_true",
+                   help="run the phase-graph fast-path A/B (full multi-pass "
+                        "program vs the dispatched full+fused build vs the "
+                        "standalone 2-pass fused program on the steady-state "
+                        "scan, bit-exactness checked first) instead of the "
+                        "standard sections; same JSON tail contract")
     p.add_argument("--manifest", metavar="PATH", default=None,
                    help="append the BENCHDOC line as a 'run' record to a "
                         "JSONL telemetry manifest (kaboodle_tpu.telemetry."
@@ -850,6 +955,33 @@ def main() -> None:
             **{k: warp[k] for k in (
                 "dense_wall_s", "warp_wall_s", "dense_ticks_executed",
                 "leaped_ticks", "bit_exact", "state_variant")},
+            "peak_rss_mib": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        }
+        _emit_benchdoc(line, manifest=args.manifest)
+        print(json.dumps(line))  # compact == full for this single-section lane
+        return
+    if args.fastpath_ab:
+        # Focused phase-graph fast-path A/B lane (ISSUE 7 acceptance:
+        # steady tick measurably under the full-program baseline, bit-exact
+        # across all three arms — PERF.md "Phase graph"). Same output
+        # contract as the warp/telemetry lanes.
+        fn = args.n or (4096 if not on_tpu else 16384)
+        ft = 64 if args.ticks is None else args.ticks
+        ab = _bench_fastpath_ab(fn, ft)
+        line = {
+            "metric": "fastpath_speedup_vs_full",
+            "value": ab["speedup"],
+            "unit": "x",
+            "n_peers": ab["n"],
+            "ticks": ab["ticks"],
+            "backend": backend + (" (fallback: accelerator unresponsive)"
+                                  if fallback else ""),
+            **{k: ab[k] for k in (
+                "full_wall_s", "dispatched_wall_s", "fused_wall_s",
+                "full_ms_per_tick", "dispatched_ms_per_tick",
+                "fused_ms_per_tick", "fused_speedup", "passes_full",
+                "passes_fused", "pruned", "bit_exact", "state_variant")},
             "peak_rss_mib": round(
                 resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
         }

@@ -1,11 +1,17 @@
 """The graftscan entry-point registry: every traced kernel the gate audits.
 
 One :class:`EntryPoint` per compiled-program family the simulator actually
-dispatches in production: the dense tick (faulty / fast-path / lean-int16 /
-random-draw variants), the chunked row-blocked twin, the warp leap scan,
-the vmapped fleet tick, the fused ops + crc32 primitives, the
-GSPMD-sharded twins, and the telemetry-plane builds (dense / lean /
-chunked / fleet tick plus the flight-recorder scan body — ISSUE 6). Each entry knows how to build ``(fn, example_args)``
+dispatches in production. Since the phase-graph refactor (ISSUE 7) every
+tick/leap family is a **derivation of the one op graph**
+(``phasegraph/derive.py``), and the registry names say which derivation:
+``phasegraph.tick.*`` (the dense full+fused dispatch and its fused /
+lean-int16 / random / blocked / telemetry / fleet / sharded builds) and
+``phasegraph.leap.*`` (the quiescent-span program). The old
+``sim.tick.*`` / ``warp.leap`` / ``fleet.tick`` entries — one registry row
+per hand-specialized protocol copy — are retired with those copies; the
+``ops.*`` primitives and the flight-recorder scan body keep their names
+(they are not graph derivations). Each entry knows how to build
+``(fn, example_args)``
 at **toy trace scale** — tracing is abstract evaluation, so N=32 exercises
 the identical program structure the production N=65,536 program has, at
 AST-adjacent cost.
@@ -90,40 +96,55 @@ def _idle(n: int = TRACE_N):
 
 
 # -- builders ---------------------------------------------------------------
+# Every tick/leap builder goes through kaboodle_tpu.phasegraph.derive — the
+# one place compiled-program families are assembled from the op graph. The
+# audited program IS the dispatched production program (sim/kernel.py etc.
+# are import shims over the same derivations).
 
 
-def _dense_faulty():
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+def _tick_faulty():
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
 
-    return make_tick_fn(_cfg(), faulty=True), (_full_state(), _idle())
-
-
-def _dense_fastpath():
-    from kaboodle_tpu.sim.kernel import make_tick_fn
-
-    return make_tick_fn(_cfg(), faulty=False), (_full_state(), _idle())
+    return make_dense_tick(_cfg(), faulty=True), (_full_state(), _idle())
 
 
-def _dense_lean():
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+def _tick_faultfree():
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
 
-    return make_tick_fn(_cfg(), faulty=False), (_lean_state(), _idle())
+    return make_dense_tick(_cfg(), faulty=False), (_full_state(), _idle())
 
 
-def _dense_random():
+def _tick_fused():
+    # The standalone 2-pass fused program (no dispatch guard) — the same
+    # faulty build bench's --fastpath-ab A/Bs. Auditing it in isolation
+    # pins its pass structure: KB401-404 see exactly the (faulty)
+    # prologue + draw + update passes, with no full-program branch to
+    # hide behind.
+    from kaboodle_tpu.phasegraph.derive import make_fused_tick
+
+    return make_fused_tick(_cfg(), faulty=True), (_full_state(), _idle())
+
+
+def _tick_lean():
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
+
+    return make_dense_tick(_cfg(), faulty=False), (_lean_state(), _idle())
+
+
+def _tick_random():
     # deterministic=False exercises the real sampling draws (gumbel /
     # bernoulli / uniform) — where dtype-less defaults hide.
     from kaboodle_tpu.config import SwimConfig
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
 
     cfg = SwimConfig(deterministic=False)
-    return make_tick_fn(cfg, faulty=True), (_full_state(), _idle())
+    return make_dense_tick(cfg, faulty=True), (_full_state(), _idle())
 
 
-def _chunked():
-    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+def _tick_blocked():
+    from kaboodle_tpu.phasegraph.derive import make_chunked_tick
 
-    fn = make_chunked_tick_fn(_cfg(), faulty=True, block=TRACE_N // 2)
+    fn = make_chunked_tick(_cfg(), faulty=True, block=TRACE_N // 2)
     return fn, (_full_state(), _idle())
 
 
@@ -133,44 +154,41 @@ def _chunked():
 # lean entry proves the int16 discipline survives the added reductions.
 
 
-def _dense_telemetry():
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+def _tick_telemetry():
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
 
     return (
-        make_tick_fn(_cfg(), faulty=True, telemetry=True),
+        make_dense_tick(_cfg(), faulty=True, telemetry=True),
         (_full_state(), _idle()),
     )
 
 
-def _dense_telemetry_lean():
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+def _tick_telemetry_lean():
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
 
     return (
-        make_tick_fn(_cfg(), faulty=False, telemetry=True),
+        make_dense_tick(_cfg(), faulty=False, telemetry=True),
         (_lean_state(), _idle()),
     )
 
 
-def _chunked_telemetry():
-    from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
+def _tick_blocked_telemetry():
+    from kaboodle_tpu.phasegraph.derive import make_chunked_tick
 
-    fn = make_chunked_tick_fn(
+    fn = make_chunked_tick(
         _cfg(), faulty=True, block=TRACE_N // 2, telemetry=True
     )
     return fn, (_full_state(), _idle())
 
 
-def _fleet_telemetry():
-    from kaboodle_tpu.fleet.core import (
-        fleet_idle_inputs,
-        init_fleet,
-        make_fleet_tick_fn,
-    )
+def _tick_fleet_telemetry():
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_fleet_tick
 
     fleet = init_fleet(TRACE_N // 2, TRACE_E)
     inputs = fleet_idle_inputs(TRACE_N // 2, TRACE_E)
     return (
-        make_fleet_tick_fn(_cfg(), faulty=True, telemetry=True),
+        make_fleet_tick(_cfg(), faulty=True, telemetry=True),
         (fleet.mesh, inputs),
     )
 
@@ -178,10 +196,10 @@ def _fleet_telemetry():
 def _recorder_scan_telemetry():
     # The converged-run shape: telemetry tick + flight-recorder ring in ONE
     # while_loop body — the program run_until_converged_telemetry dispatches.
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
     from kaboodle_tpu.telemetry.recorder import init_recorder, record_tick
 
-    tick = make_tick_fn(_cfg(), faulty=False, telemetry=True)
+    tick = make_dense_tick(_cfg(), faulty=False, telemetry=True)
     rec0 = init_recorder(8, TRACE_N)
 
     def tick_and_record(st, inp, rec):
@@ -191,38 +209,36 @@ def _recorder_scan_telemetry():
     return tick_and_record, (_full_state(), _idle(), rec0)
 
 
-def _warp_leap():
-    from kaboodle_tpu.warp.leap import make_leap_fn
+def _leap():
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
 
-    return make_leap_fn(_cfg(), LEAP_K), (_converged_state(),)
-
-
-def _warp_leap_lean():
-    from kaboodle_tpu.warp.leap import make_leap_fn
-
-    return make_leap_fn(_cfg(), LEAP_K), (_lean_state(converged=True),)
+    return make_warp_leap(_cfg(), LEAP_K), (_converged_state(),)
 
 
-def _fleet_tick():
-    from kaboodle_tpu.fleet.core import (
-        fleet_idle_inputs,
-        init_fleet,
-        make_fleet_tick_fn,
-    )
+def _leap_lean():
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+
+    return make_warp_leap(_cfg(), LEAP_K), (_lean_state(converged=True),)
+
+
+def _tick_fleet():
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_fleet_tick
 
     fleet = init_fleet(TRACE_N // 2, TRACE_E)
     inputs = fleet_idle_inputs(TRACE_N // 2, TRACE_E)
-    return make_fleet_tick_fn(_cfg(), faulty=True), (fleet.mesh, inputs)
+    return make_fleet_tick(_cfg(), faulty=True), (fleet.mesh, inputs)
 
 
-def _sharded_tick():
-    from kaboodle_tpu.parallel.mesh import make_mesh, make_sharded_tick
+def _tick_sharded():
+    from kaboodle_tpu.parallel.mesh import make_mesh
+    from kaboodle_tpu.phasegraph.derive import make_sharded_tick
 
     mesh = make_mesh(len(_devices()))
     return make_sharded_tick(_cfg(), mesh, faulty=False), (_full_state(), _idle())
 
 
-def _sharded_leap():
+def _leap_sharded():
     import jax
 
     from kaboodle_tpu.parallel.mesh import (
@@ -230,11 +246,11 @@ def _sharded_leap():
         make_mesh,
         row_matrix_sharding,
     )
-    from kaboodle_tpu.warp.leap import make_leap_fn
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
 
     mesh = make_mesh(len(_devices()))
     sharding = row_matrix_sharding(mesh)
-    leap = make_leap_fn(
+    leap = make_warp_leap(
         _cfg(), LEAP_K, constrain=lambda x: jax.lax.with_sharding_constraint(x, sharding)
     )
 
@@ -244,7 +260,7 @@ def _sharded_leap():
     return sharded_leap, (_converged_state(),)
 
 
-def _sharded_fleet_tick():
+def _tick_fleet_sharded():
     from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
     from kaboodle_tpu.fleet.sharding import make_fleet_mesh, make_sharded_fleet_tick
 
@@ -319,22 +335,27 @@ def _devices():
 
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
-    EntryPoint("sim.tick.dense.faulty", _dense_faulty),
-    EntryPoint("sim.tick.dense.fastpath", _dense_fastpath),
-    EntryPoint("sim.tick.dense.lean", _dense_lean, lean=True),
-    EntryPoint("sim.tick.dense.random", _dense_random),
-    EntryPoint("sim.tick.chunked", _chunked),
-    EntryPoint("sim.tick.dense.telemetry", _dense_telemetry),
-    EntryPoint("sim.tick.dense.telemetry.lean", _dense_telemetry_lean, lean=True),
-    EntryPoint("sim.tick.chunked.telemetry", _chunked_telemetry),
-    EntryPoint("fleet.tick.telemetry", _fleet_telemetry),
+    # phasegraph derivations (the retired sim.tick.* / warp.leap /
+    # fleet.tick rows were the per-copy entries of the four deleted
+    # hand-specialized kernels; phasegraph.tick.fused is NEW — the
+    # standalone 2-pass program bench's --fastpath-ab dispatches).
+    EntryPoint("phasegraph.tick.faulty", _tick_faulty),
+    EntryPoint("phasegraph.tick.faultfree", _tick_faultfree),
+    EntryPoint("phasegraph.tick.fused", _tick_fused),
+    EntryPoint("phasegraph.tick.lean", _tick_lean, lean=True),
+    EntryPoint("phasegraph.tick.random", _tick_random),
+    EntryPoint("phasegraph.tick.blocked", _tick_blocked),
+    EntryPoint("phasegraph.tick.telemetry", _tick_telemetry),
+    EntryPoint("phasegraph.tick.telemetry.lean", _tick_telemetry_lean, lean=True),
+    EntryPoint("phasegraph.tick.blocked.telemetry", _tick_blocked_telemetry),
+    EntryPoint("phasegraph.tick.fleet.telemetry", _tick_fleet_telemetry),
     EntryPoint("sim.recorder.telemetry", _recorder_scan_telemetry),
-    EntryPoint("warp.leap", _warp_leap),
-    EntryPoint("warp.leap.lean", _warp_leap_lean, lean=True),
-    EntryPoint("fleet.tick", _fleet_tick),
-    EntryPoint("parallel.tick.sharded", _sharded_tick, sharded=True),
-    EntryPoint("warp.leap.sharded", _sharded_leap, sharded=True),
-    EntryPoint("fleet.tick.sharded", _sharded_fleet_tick, sharded=True),
+    EntryPoint("phasegraph.leap", _leap),
+    EntryPoint("phasegraph.leap.lean", _leap_lean, lean=True),
+    EntryPoint("phasegraph.tick.fleet", _tick_fleet),
+    EntryPoint("phasegraph.tick.sharded", _tick_sharded, sharded=True),
+    EntryPoint("phasegraph.leap.sharded", _leap_sharded, sharded=True),
+    EntryPoint("phasegraph.tick.fleet.sharded", _tick_fleet_sharded, sharded=True),
     EntryPoint("ops.fused_fp", _ops_fused_fp),
     EntryPoint("ops.fused_oldest_k", _ops_fused_oldest_k),
     EntryPoint("ops.fused_suspicion", _ops_fused_suspicion),

@@ -132,7 +132,7 @@ def _prep_dense():
     import jax
     import jax.numpy as jnp
 
-    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
     from kaboodle_tpu.sim.state import idle_inputs, init_state
 
     cfg = _cfg()
@@ -146,9 +146,9 @@ def _prep_dense():
         dc.replace(idle, drop_rate=jnp.float32(0.1)),
     )
     return {
-        "tick": jax.jit(make_tick_fn(cfg, faulty=True)),
-        "fast": jax.jit(make_tick_fn(cfg, faulty=False)),
-        "lean": jax.jit(make_tick_fn(cfg, faulty=False)),
+        "tick": jax.jit(make_dense_tick(cfg, faulty=True)),
+        "fast": jax.jit(make_dense_tick(cfg, faulty=False)),
+        "lean": jax.jit(make_dense_tick(cfg, faulty=False)),
         "st": init_state(n, seed=0),
         "stf": init_state(n, seed=1),
         "stl": init_state(

@@ -39,6 +39,12 @@ HOT_DIRS = (
     # cross-engine counter-parity pins. The export half (manifest/trace/
     # summary) is host-side by design — untraced, so KB301 stays quiet.
     "kaboodle_tpu/telemetry/",
+    # phasegraph/: where the protocol logic actually lives now — the one
+    # op graph every engine (dense/fused/chunked/sharded/fleet/warp) is
+    # derived from. sim/kernel.py, sim/chunked.py and warp/leap.py are
+    # shims over it, so a host sync or dtype drift HERE is one landing in
+    # all five compiled program families at once.
+    "kaboodle_tpu/phasegraph/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
@@ -63,6 +69,12 @@ DTYPE_DISCIPLINE_FILES = (
     # the recorder ring's slots must hold the exact dtypes the counters
     # carry or the dump re-defines what the parity fuzz compared.
     "counters.py", "recorder.py",
+    # phasegraph/: the derived-engine bodies (exec.py dense full+fused,
+    # blocked.py chunked twin, span.py warp leap). These inherited the
+    # kernel.py/chunked.py/leap.py discipline wholesale — int8 state,
+    # int16/int32 timers with sentinel wraparound, uint32 fingerprints —
+    # and every parity pin in the tree compares THEIR outputs now.
+    "exec.py", "blocked.py", "span.py",
 )
 
 _CONSTRUCTORS = {

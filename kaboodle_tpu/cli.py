@@ -405,6 +405,13 @@ def main(argv=None) -> int:
         from kaboodle_tpu.telemetry.summary import main as telemetry_main
 
         return telemetry_main(argv[1:])
+    if argv and argv[0] == "phasegraph":
+        # Derived-engine dryrun subcommand (phasegraph/dryrun.py): build
+        # every engine the planner derives from the op graph at toy N,
+        # run one tick each, diff bit-for-bit against dense.
+        from kaboodle_tpu.phasegraph.dryrun import main as phasegraph_main
+
+        return phasegraph_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         if args.sim or args.sim_scenario:

@@ -46,7 +46,6 @@ import jax
 import jax.numpy as jnp
 
 from kaboodle_tpu.config import SwimConfig
-from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.runner import state_converged
 from kaboodle_tpu.sim.state import (
     MeshState,
@@ -171,36 +170,24 @@ def scan_axis_first(inputs: TickInputs) -> TickInputs:
 
 
 def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True, telemetry: bool = False):
-    """The single-mesh tick kernel vmapped over the leading ensemble axis.
+    """The phase-graph fleet derivation: the dense tick vmapped over ``[E]``.
 
     One compiled program advances all E members a tick; every ``lax.cond``
     the kernel gates rare phases with batches to a select under ``vmap``
     (both branches execute for the whole fleet whenever any member needs
     one — the lockstep price of batching; the [E]-wide masks keep the
-    results exact). The fused Pallas stage kernels do not batch — they are
-    demoted-off by default (PERF.md "Pallas policy") and rejected here so a
-    config that re-enables them fails loudly instead of miscompiling under
-    vmap.
+    results exact). For that reason the fleet build compiles the FULL
+    program only — see :func:`kaboodle_tpu.phasegraph.derive.make_fleet_tick`
+    for the derivation and the exactness argument.
 
     ``telemetry=True`` vmaps the telemetry-plane tick: member ``e``'s
     ``ProtocolCounters`` / fingerprint digests are bit-exact with a
     standalone telemetry run from the same seed, by the same argument as
     the state parity contract (vmap batches the identical per-row ops).
     """
-    if cfg.use_pallas_fp or cfg.use_pallas_oldest_k or cfg.use_pallas_suspicion:
-        raise ValueError(
-            "fleet: the fused Pallas stage kernels do not support vmap; "
-            "use the default jnp formulations (use_pallas_*=False)"
-        )
-    vtick = jax.vmap(make_tick_fn(cfg, faulty=faulty, telemetry=telemetry))
+    from kaboodle_tpu.phasegraph.derive import make_fleet_tick
 
-    # Named scope for jax.profiler captures (metadata only; wraps the
-    # whole vmapped dispatch so fleet ops group under one label).
-    @jax.named_scope("kaboodle:fleet_tick")
-    def fleet_tick(mesh: MeshState, inputs: TickInputs):
-        return vtick(mesh, inputs)
-
-    return fleet_tick
+    return make_fleet_tick(cfg, faulty=faulty, telemetry=telemetry)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "faulty", "telemetry"))

@@ -34,7 +34,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kaboodle_tpu.config import SwimConfig
-from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.runner import converge_loop
 from kaboodle_tpu.sim.state import MeshState, TickInputs
 
@@ -217,15 +216,12 @@ def make_sharded_tick(
     ``telemetry=True`` selects the telemetry-plane tick (the outputs are
     per-tick scalars plus an [N] digest vector, which GSPMD reduces/gathers
     like the existing metrics — only the constrained carry needs the pin).
+    Derived via :func:`kaboodle_tpu.phasegraph.derive.make_sharded_tick`
+    (lazy import: this module provides ``constrain_state`` to it).
     """
-    tick = make_tick_fn(cfg, faulty=faulty, telemetry=telemetry)
+    from kaboodle_tpu.phasegraph.derive import make_sharded_tick as _derive
 
-    def sharded_tick(st: MeshState, inp: TickInputs):
-        st, m = tick(st, inp)
-        st = constrain_state(st, mesh)
-        return st, m
-
-    return sharded_tick
+    return _derive(cfg, mesh, faulty=faulty, telemetry=telemetry)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh", "faulty"))

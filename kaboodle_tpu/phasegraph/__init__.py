@@ -1,0 +1,42 @@
+"""Phase-graph tick IR: one op graph, five derived engines.
+
+The SWIM tick is declared ONCE as a graph of composable phase ops
+(:mod:`~kaboodle_tpu.phasegraph.ops`), each declaring the state fields it
+reads and writes, the tick-local values it produces and consumes, and the
+traced activity mask that gates its real work. A planner
+(:mod:`~kaboodle_tpu.phasegraph.plan`) composes the graph per build:
+
+- ``full`` — the multi-pass program (one pass per cond-gated phase), the
+  shape every engine ran before this module existed;
+- ``fused`` — the 2-pass steady-tick program: ops whose activity is
+  excluded by the dispatch predicate are *pruned* (the predicate terms are
+  derived from the pruned ops' own activity declarations), and the
+  survivors' masks *fold* into one elementwise where chain — no cond-gated
+  identity branches, ~3 HBM sweep-equivalents instead of ~9;
+- ``span`` — the warp-leap derivation: ops that are provably fixed points
+  inside a quiescent span are pruned, the survivors degenerate to the
+  timer-restamp/latency-decay forms the leap kernel batches;
+- ``blocked`` — the chunked derivation: the full pass order with every
+  [N, N] pass re-expressed over row blocks (O(block·N) transients).
+
+The executable engines are all derived from this one graph
+(:mod:`~kaboodle_tpu.phasegraph.derive`): the dense tick (full+fused under
+a per-tick dispatch), the standalone fused fast path, the chunked
+row-blocked twin, the GSPMD-sharded twin, the vmapped fleet tick, and the
+warp leap. ``sim/kernel.py``, ``sim/chunked.py``, ``warp/leap.py`` and
+``fleet/core.py`` are thin shims over these derivations — the four
+hand-specialized protocol copies they used to hold are deleted.
+"""
+
+from kaboodle_tpu.phasegraph.graph import TickGraph, build_graph
+from kaboodle_tpu.phasegraph.ops import PhaseOp
+from kaboodle_tpu.phasegraph.plan import Pass, TickProgram, plan
+
+__all__ = [
+    "PhaseOp",
+    "TickGraph",
+    "build_graph",
+    "Pass",
+    "TickProgram",
+    "plan",
+]

@@ -1,0 +1,159 @@
+"""Derive the five engines from the one tick op graph.
+
+Every compiled-program family the simulator dispatches is built HERE, from
+the same :func:`~kaboodle_tpu.phasegraph.graph.build_graph` output:
+
+==========  ==============================================================
+engine      derivation
+==========  ==============================================================
+dense       exec.py's full + fused programs under the planner-derived
+            per-tick dispatch (``make_dense_tick``); ``make_fused_tick``
+            pins the 2-pass fused program alone (A/B + audit builds)
+chunked     the blocked program: same ops/order, row-blocked layout
+            (``make_chunked_tick`` -> blocked.py)
+sharded     the dense tick wrapped in GSPMD sharding constraints so the
+            scan carry keeps one placement (``make_sharded_tick``)
+fleet       the dense tick vmapped over a leading ensemble axis
+            (``make_fleet_tick``); every ``lax.cond`` batches to a select,
+            so the fleet build compiles the FULL program only — under vmap
+            both dispatch branches would execute for the whole ensemble
+            every tick, making the fused branch pure added work. Values
+            are unchanged either way (the dispatch is bit-exact by
+            contract), so member-vs-standalone parity holds.
+warp        the span program: invariant ops pruned, survivors batched as
+            one k-tick scan (``make_warp_leap`` -> span.py)
+==========  ==============================================================
+
+``fleet/core.py``, ``parallel/mesh.py``, ``sim/kernel.py``,
+``sim/chunked.py`` and ``warp/leap.py`` are shims/wrappers over these
+builders — the protocol logic exists once, under ``phasegraph/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from kaboodle_tpu.config import SwimConfig
+
+# The executable-engine imports are lazy (inside each builder): exec.py /
+# blocked.py / span.py import sim.state, whose package __init__ imports the
+# sim.kernel/sim.chunked shims, which import back into this package — an
+# eager import here would hit those modules half-initialized when a caller
+# enters through `kaboodle_tpu.phasegraph` first.
+
+# The engine names, for dryrun/docs enumeration.
+ENGINES = ("dense", "fused", "chunked", "sharded", "fleet", "warp")
+
+
+def make_dense_tick(
+    cfg: SwimConfig, faulty: bool = True, telemetry: bool = False
+) -> Callable:
+    """The production dense tick: full + fused programs, per-tick dispatch."""
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    return make_tick_fn(cfg, faulty=faulty, telemetry=telemetry)
+
+
+def make_fused_tick(
+    cfg: SwimConfig, faulty: bool = True, telemetry: bool = False
+) -> Callable:
+    """The standalone 2-pass fused program (no dispatch guard).
+
+    Callers own the precondition that every tick satisfies the dispatch
+    predicate (no Join broadcast, no suspicion activity); bench's
+    ``--fastpath-ab`` lane bit-checks it against the dispatched build
+    before reporting a number.
+    """
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    return make_tick_fn(cfg, faulty=faulty, telemetry=telemetry, program="fused")
+
+
+def make_chunked_tick(
+    cfg: SwimConfig,
+    faulty: bool = True,
+    block: int = 1024,
+    drop: bool = True,
+    boot_union: bool = False,
+    telemetry: bool = False,
+) -> Callable:
+    """The row-blocked (O(block·N)-transient) derivation."""
+    from kaboodle_tpu.phasegraph.blocked import make_chunked_tick_fn
+
+    return make_chunked_tick_fn(
+        cfg, faulty=faulty, block=block, drop=drop, boot_union=boot_union,
+        telemetry=telemetry,
+    )
+
+
+def make_fleet_tick(
+    cfg: SwimConfig, faulty: bool = True, telemetry: bool = False
+) -> Callable:
+    """The dense tick vmapped over the leading ensemble axis.
+
+    Compiles the FULL program only (``fast_path=False``): under ``vmap``
+    the dispatch ``lax.cond`` batches to a select that executes BOTH
+    branches for the whole ensemble whenever any member needs the full
+    path — on fault scenarios that is nearly every tick, so the fused
+    branch would be pure added sweeps. The dispatch is bit-exact by
+    contract (tests/test_fast_path.py), so member trajectories are
+    unchanged and the member-vs-standalone parity pins hold.
+
+    The fused Pallas stage kernels do not batch — they are demoted-off by
+    default (PERF.md "Pallas policy") and rejected here so a config that
+    re-enables them fails loudly instead of miscompiling under vmap.
+    """
+    import jax
+
+    if cfg.use_pallas_fp or cfg.use_pallas_oldest_k or cfg.use_pallas_suspicion:
+        raise ValueError(
+            "fleet: the fused Pallas stage kernels do not support vmap; "
+            "use the default jnp formulations (use_pallas_*=False)"
+        )
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    vcfg = dataclasses.replace(cfg, fast_path=False)
+    vtick = jax.vmap(make_tick_fn(vcfg, faulty=faulty, telemetry=telemetry))
+
+    # Named scope for jax.profiler captures (metadata only; wraps the
+    # whole vmapped dispatch so fleet ops group under one label).
+    @jax.named_scope("kaboodle:fleet_tick")
+    def fleet_tick(mesh, inputs):
+        return vtick(mesh, inputs)
+
+    return fleet_tick
+
+
+def make_sharded_tick(
+    cfg: SwimConfig, mesh, faulty: bool = True, telemetry: bool = False
+) -> Callable:
+    """The dense tick with its carry constrained onto the device mesh.
+
+    The constraint after every tick keeps the scan carry's sharding fixed,
+    so XLA partitions each tick identically instead of re-deciding
+    layouts. The dispatch predicate is a global reduction the partitioner
+    all-reduces; both programs partition row-locally like before.
+    """
+    from kaboodle_tpu.parallel.mesh import constrain_state
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    tick = make_tick_fn(cfg, faulty=faulty, telemetry=telemetry)
+
+    def sharded_tick(st, inp):
+        st, m = tick(st, inp)
+        st = constrain_state(st, mesh)
+        return st, m
+
+    sharded_tick.graph = tick.graph
+    sharded_tick.programs = tick.programs
+    return sharded_tick
+
+
+def make_warp_leap(
+    cfg: SwimConfig, k: int, constrain: Callable | None = None
+) -> Callable:
+    """The span program: k quiescent ticks as one batched scan."""
+    from kaboodle_tpu.phasegraph.span import make_leap_fn
+
+    return make_leap_fn(cfg, k, constrain=constrain)

@@ -1,0 +1,134 @@
+"""``python -m kaboodle_tpu phasegraph`` — derive all engines, diff vs dense.
+
+The CI end-to-end proof of the phase-graph contract (ISSUE 7): every
+executable engine the planner derives from the one op graph — dense
+(full+fused dispatch), standalone fused, chunked (row-blocked), sharded,
+fleet (vmapped), warp leap — is built at toy N, runs real ticks, and its
+outputs are diffed bit-for-bit against the dense derivation:
+
+- chunked / sharded: one faulty tick from the same state + inputs;
+- fleet: member 0 of a vmapped tick vs a standalone dense tick of the same
+  member state (the full-program-only derivation vs the dispatched build —
+  exactly the exactness argument derive.make_fleet_tick documents);
+- fused: one steady tick on a converged mesh vs the dispatched build (the
+  dispatch predicate must route that tick to the same values);
+- warp: a k-tick leap vs k dense fault-free ticks on a quiescent mesh.
+
+Any mismatch exits nonzero. This is a *dryrun* (wiring + exactness at toy
+scale, seconds on CPU); the at-scale exactness contracts live in the parity
+suites (tests/test_kernel_parity.py, test_warp.py, test_fleet.py,
+test_fuzz_parity.py) and the measured numbers in ``bench.py --fastpath-ab``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _state_equal(a, b) -> bool:
+    # The canonical predicate (kaboodle_tpu.profiling.leaf_equal) — the
+    # same definition of "bit-exact" the bench A/B lanes gate on.
+    from kaboodle_tpu.profiling import state_equal
+
+    return state_equal(a, b)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kaboodle_tpu phasegraph",
+        description="build every phase-graph-derived engine at toy N, run "
+                    "one tick each, diff against the dense derivation",
+    )
+    p.add_argument("--n", type=int, default=32, help="toy mesh size")
+    p.add_argument("--ensemble", type=int, default=4, help="fleet width E")
+    p.add_argument("--leap", type=int, default=4, help="warp span length k")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
+    from kaboodle_tpu.parallel.mesh import make_mesh
+    from kaboodle_tpu.phasegraph import build_graph, plan
+    from kaboodle_tpu.phasegraph.derive import (
+        make_chunked_tick,
+        make_dense_tick,
+        make_fleet_tick,
+        make_fused_tick,
+        make_sharded_tick,
+        make_warp_leap,
+    )
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    n, e, k = args.n, args.ensemble, args.leap
+    cfg = SwimConfig(deterministic=True)
+    results: dict[str, bool] = {}
+
+    # The plans, straight from the planner (the op/pass summary CI logs).
+    graph = build_graph(cfg, faulty=True)
+    for mode in ("full", "fused"):
+        prog = plan(graph, mode)
+        print(f"phasegraph: plan {mode}: {len(prog.passes)} passes, "
+              f"{len(prog.pruned)} pruned, ops="
+              f"{','.join(prog.op_names())}")
+
+    # dense (the reference arm) — one faulty tick from a cold boot state.
+    st = init_state(n, seed=0)
+    idle = idle_inputs(n)
+    dense = make_dense_tick(cfg, faulty=True)
+    st_d, m_d = jax.jit(dense)(st, idle)
+
+    # chunked: same tick, row-blocked layout.
+    chunked = make_chunked_tick(cfg, faulty=True, block=n // 2)
+    st_c, m_c = jax.jit(chunked)(st, idle)
+    results["chunked"] = _state_equal(st_d, st_c) and _state_equal(m_d, m_c)
+
+    # sharded: same tick, carry constrained onto the device mesh.
+    sharded = make_sharded_tick(cfg, make_mesh(len(jax.devices())), faulty=True)
+    st_s, m_s = jax.jit(sharded)(st, idle)
+    results["sharded"] = _state_equal(st_d, st_s) and _state_equal(m_d, m_s)
+
+    # fleet: member 0 of the vmapped tick vs a standalone dense tick of the
+    # same member state (the full-only derivation vs the dispatched build).
+    fleet = init_fleet(n, e)
+    ftick = make_fleet_tick(cfg, faulty=True)
+    fm, _ = jax.jit(ftick)(fleet.mesh, fleet_idle_inputs(n, e))
+    m0 = jax.tree.map(lambda x: x[0], fleet.mesh)
+    st_m0, _ = jax.jit(dense)(m0, idle)
+    results["fleet"] = _state_equal(jax.tree.map(lambda x: x[0], fm), st_m0)
+
+    # fused: one steady tick on a converged mesh — the standalone 2-pass
+    # program must equal the dispatched build routing the same tick.
+    conv = init_state(n, seed=1, ring_contacts=n - 1, announced=True)
+    fused = make_fused_tick(cfg, faulty=True)
+    st_f, m_f = jax.jit(fused)(conv, idle)
+    st_dd, m_dd = jax.jit(dense)(conv, idle)
+    results["fused"] = _state_equal(st_dd, st_f) and _state_equal(m_dd, m_f)
+
+    # warp: a k-tick leap vs k dense fault-free ticks on the quiescent mesh.
+    leap = make_warp_leap(cfg, k)
+    st_w = jax.jit(leap)(conv)
+    st_k = conv
+    dense_ff = make_dense_tick(cfg, faulty=False)
+    step = jax.jit(dense_ff)
+    for _ in range(k):
+        st_k, _ = step(st_k, idle)
+    results["warp"] = _state_equal(st_w, st_k)
+
+    ok = all(results.values())
+    for name, good in results.items():
+        print(f"phasegraph: {name:8s} vs dense: {'bit-exact' if good else 'MISMATCH'}")
+    print(json.dumps({
+        "metric": "phasegraph_dryrun",
+        "n": n, "ensemble": e, "leap": k,
+        "engines": {name: ("ok" if good else "mismatch")
+                    for name, good in results.items()},
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

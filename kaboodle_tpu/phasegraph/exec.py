@@ -1,0 +1,1435 @@
+"""The executable tick: the phase graph's dense (full + fused) programs.
+
+This module holds the ONE implementation of the SWIM tick's phase ops —
+every other engine derives from it (derive.py): the chunked twin re-lays
+the same passes over row blocks (blocked.py), the fleet tick vmaps it, the
+sharded tick wraps it in GSPMD constraints, and the warp leap executes the
+planner's span program (span.py). ``sim/kernel.py`` is a shim.
+
+``make_tick_fn`` composes the two planned programs for a build
+(plan.py): the FULL program (one pass per cond-gated phase — the
+pass-count-bound shape, ~9 HBM sweep-equivalents on active ticks) and the
+FUSED program (the 2-pass steady-tick program: one draw pass + one update
+pass whose masks fold into a single elementwise where chain, ~3
+sweep-equivalents). The per-tick dispatch predicate between them is
+DERIVED from the planner: ``plan(graph, "fused").pred_terms`` names the
+activity symbols (``any_a2``, ``any_join``) whose disjunction guarantees
+every pruned op is inactive, so the fused program is taken exactly when it
+is bit-exact. Since the phase-graph refactor the dispatch applies to BOTH
+fault-free and faulty builds (churn and the delivery gate are prologue
+ops, shared by the two branches; drop/partition/kill/revive traffic flows
+through the fused update's edge gathers unchanged) — quiet faulty ticks,
+the overwhelming majority of every fault scenario's span, no longer pay
+the full path's cond boundaries.
+
+The protocol semantics below are the TPU-native re-expression of the
+reference's loop
+(kaboodle.rs:746-786): where the reference runs one tokio task per OS process
+per peer, here all N peers advance together, one tick per kernel invocation,
+with every per-peer branch turned into a masked tensor op. The kernel is the
+executable twin of :class:`kaboodle_tpu.oracle.lockstep.LockstepMesh` — the
+round structure below mirrors its docstring, and
+``tests/test_kernel_parity.py`` pins exact state equality per tick in
+deterministic mode.
+
+Round structure per tick t (lockstep.py round letters):
+  A  active phase (kaboodle.rs:746-757): Join broadcasts, suspicion handling
+     (escalation to indirect ping / removals), random ping, manual pings.
+  B  broadcast delivery: Join inserts at every receiver + join-response
+     KnownPeers queued (kaboodle.rs:256-311).
+  1  call 1: deliver active-phase Pings + PingRequests; Acks + proxy Pings
+     queued (kaboodle.rs:513-545).
+  2  call 2: deliver direct Acks, proxy Pings, join responses; target Acks
+     queued; gossip-learned peers inserted back-dated (Q6, kaboodle.rs:448-472).
+  3  call 3: deliver targets' Acks to proxies; forwarded Acks queued to the
+     curious suspectors (kaboodle.rs:418-447).
+  4  call 4: deliver forwarded Acks.
+  G  anti-entropy: each peer resolves <= 1 KnownPeersRequest (deviation D2,
+     kaboodle.rs:707-740); request + filtered reply resolve within the tick.
+
+Within each delivery call, all sender-marks (Q1: any inbound datagram marks
+its sender Known(now), kaboodle.rs:408-415) apply before any dispatch — the
+same serialization the lockstep oracle implements with its two-pass
+``_deliver_round``.
+
+Documented deviations beyond the oracle's D1-D3 (see PARITY.md):
+- D5: when a join-response share exceeds ``max_share_peers``, the kernel caps
+  to the lowest-index members of the responder's start-of-round map (the
+  oracle trims the exact per-joiner snapshot). Inactive when N <= cap.
+- D6: in random (non-deterministic) mode, the join-reply Bernoulli probability
+  uses the exact sequential map size (a cumulative sum over joiners, matching
+  kaboodle.rs:344-353 processing order), but the random draws themselves are
+  counter-based `jax.random`, so random-mode parity with the oracle is
+  distributional, not samplewise.
+
+Memory/layout notes (TPU):
+- ``state`` int8 and ``timer`` int32 (int16 in lean mode) are the only
+  mandatory [N, N] residents; every
+  message "queue" is O(N) or O(N·k) (the per-tick fan-outs are bounded by the
+  protocol: 1 ping, k=3 ping-reqs, 1 anti-entropy request per peer).
+- The only O(N^3) work is the join-response gossip union (and, in
+  intended-semantics mode, the Failed-broadcast delivery), expressed as int8
+  matmuls (MXU-friendly) and skipped via ``lax.cond`` on ticks with no Join
+  broadcast (resp. no removal).
+- Everything is static-shaped; the whole tick jits into one XLA program and
+  rolls under ``lax.scan`` (runner.py).
+- ``fast_path`` builds (faulty or not) compile a TWO-BRANCH tick selected
+  by ``lax.cond`` per tick: ticks where the planner-derived predicate is
+  False (no Join broadcast, no suspicion activity — the overwhelming
+  majority of boot, steady state, and calm recovery) take ``_fast``, the
+  fused program, whose delivery masks all derive from O(N) vectors so the
+  [N, N] work collapses to the stats read, the eligibility/draw read, and
+  one composed write chain; everything else takes ``_rest``, the full
+  path. The split exists because the round-4 on-TPU phase decomposition
+  (PERF.md) showed the full path's per-phase ``cond`` boundaries force ~9
+  materialized HBM sweeps where these ticks need ~3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.fused_fp import fused_fp_count, pallas_supported
+from kaboodle_tpu.ops.fused_oldest_k import fused_oldest_k, pallas_oldest_k_supported
+from kaboodle_tpu.ops.fused_suspicion import fused_suspicion, pallas_suspicion_supported
+from kaboodle_tpu.ops.hashing import fingerprint_agreement, peer_record_hash
+from kaboodle_tpu.ops.sampling import (
+    bernoulli_matrix,
+    broadcast_reply_prob,
+    choose_among_candidates,
+    choose_k_members,
+    choose_one_of_oldest_k,
+)
+from kaboodle_tpu.phasegraph.graph import build_graph
+from kaboodle_tpu.phasegraph.plan import plan
+from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
+from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
+from kaboodle_tpu.telemetry.counters import (
+    RECORD_BYTES,
+    ProtocolCounters,
+    TickTelemetry,
+)
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _bool_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Boolean OR-matmul: (a @ b) > 0 with int8 inputs, int32 accumulation.
+
+    int8 x int8 -> int32 rides the MXU on TPU (v5e runs int8 at 2x bf16)."""
+    acc = jax.lax.dot_general(
+        a.astype(jnp.int8),
+        b.astype(jnp.int8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc > 0
+
+
+def _scatter_or(dst: jax.Array, rows: jax.Array, cols: jax.Array, val: jax.Array) -> jax.Array:
+    """dst[rows, cols] |= val with -1-safe indices (val must be False there).
+
+    XLA lowers a dynamic-index scatter to a sequential per-update loop on
+    TPU, so this is reserved for escalation-gated paths (which are compiled
+    out of steady-state ticks); the per-tick hot marks use the dense one-hot
+    forms below, which fuse into their consuming ``where`` passes."""
+    return dst.at[jnp.clip(rows, 0), jnp.clip(cols, 0)].max(val)
+
+
+def _col_mark(idx: jax.Array, tgt: jax.Array, val: jax.Array) -> jax.Array:
+    """mark[d, s] = (tgt[s] == d) & val[s] — sender s's datagram lands at its
+    target. tgt == -1 never matches (idx >= 0), so no clipping is needed."""
+    return (idx[:, None] == tgt[None, :]) & val[None, :]
+
+
+def _row_mark(idx: jax.Array, tgt: jax.Array, val: jax.Array) -> jax.Array:
+    """mark[s, d] = (tgt[s] == d) & val[s] — row s marks its own target."""
+    return (idx[None, :] == tgt[:, None]) & val[:, None]
+
+
+def _gather_edge(mat: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
+    """mat[rows, cols] with -1-safe (clipped) indices."""
+    return mat[jnp.clip(rows, 0), jnp.clip(cols, 0)]
+
+
+# The op bodies this module implements, by program region: the shared
+# prologue runs before the dispatch, ``_rest`` executes the full program's
+# tail in graph order, ``_fast`` executes the fused program's two passes
+# (the A3 draw, then the folded update chain). ``_check_programs`` pins
+# implementation coverage to the planner's output at build time, so a new
+# graph op without a body here (or a planner regrouping the fold illegally)
+# is a loud build error, never a silent semantic drift.
+_PROLOGUE_OPS = frozenset(
+    ("rng_split", "churn", "delivery_gate", "row_stats", "join_gate",
+     "manual_targets")
+)
+_FULL_TAIL_OPS = frozenset(
+    ("suspicion", "probe_draw", "join_insert", "failed_delivery",
+     "join_replies", "call1", "call2", "calls34", "anti_entropy",
+     "counters", "finish")
+)
+_FUSED_DRAW_OPS = frozenset(("probe_draw",))
+_FUSED_UPDATE_OPS = frozenset(
+    ("call1", "call2", "anti_entropy", "counters", "finish")
+)
+_PRED_TERMS = frozenset(("any_a2", "any_join"))
+
+
+def _check_programs(graph, full_prog, fused_prog) -> None:
+    names = {op.name for op in graph.ops}
+    unimplemented = names - _PROLOGUE_OPS - _FULL_TAIL_OPS
+    if unimplemented:
+        raise NotImplementedError(
+            f"graph ops without exec bodies: {sorted(unimplemented)}"
+        )
+    for op in graph.prologue:
+        if op.name not in _PROLOGUE_OPS:
+            raise NotImplementedError(f"{op.name}: no prologue body")
+    draw, update = fused_prog.tail
+    if not (set(draw.op_names) <= _FUSED_DRAW_OPS
+            and set(update.op_names) <= _FUSED_UPDATE_OPS):
+        raise NotImplementedError(
+            "fused plan groups ops this module does not fold: "
+            f"draw={draw.op_names} update={update.op_names}"
+        )
+    if not set(fused_prog.pred_terms) <= _PRED_TERMS:
+        raise NotImplementedError(
+            f"unknown dispatch pred terms {fused_prog.pred_terms}"
+        )
+    if not set(graph.cut_labels) <= {"A", "c1", "c2", "c34", "G"}:
+        raise NotImplementedError(f"unknown cut labels {graph.cut_labels}")
+
+
+def make_tick_fn(
+    cfg: SwimConfig,
+    faulty: bool = True,
+    _cut: str | None = None,
+    telemetry: bool = False,
+    program: str | None = None,
+) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
+    """Build the jittable tick function for a given protocol config.
+
+    ``cfg`` is baked in (static): protocol constants fold into the compiled
+    program. ``faulty=False`` compiles out the churn/partition/drop paths for
+    the fault-free fast path (bench configs 2 and 4).
+
+    ``telemetry=True`` compiles the telemetry-plane build: the tick returns
+    ``(state, TickTelemetry(metrics, counters, fp))`` instead of
+    ``(state, TickMetrics)``, where ``counters`` is the
+    :class:`~kaboodle_tpu.telemetry.counters.ProtocolCounters` pytree of
+    this tick's protocol reductions and ``fp`` the end-of-tick per-member
+    fingerprint vector (the flight recorder's digest plane). Every counter
+    is a pure derived value of masks/states the tick already computes: the
+    state trajectory is bit-identical with telemetry on or off, and the
+    ``telemetry=False`` program is byte-for-byte today's (the flag only
+    *adds* outputs). Counter semantics are pinned against the lockstep
+    oracle's tallies by the counter-parity fuzz (tests/test_fuzz_parity.py).
+
+    ``_cut`` is a perf-probe hook (scripts/tpu_stage_probe.py), not protocol
+    surface: a static phase label ("A", "c1", "c2", "c34", "G") that truncates
+    the compiled full path right after that phase, returning the partial state
+    with zeroed metrics. Timing successive cuts under one scan isolates each
+    phase's *in-context* cost — isolated stage microbenches mispredict what
+    XLA fuses inside the real program. ``None`` (the default, and the only
+    value any production path uses) compiles the normal tick; any other value
+    also disables the fast/slow split so the probe times the full path.
+
+    ``program`` selects which planned program to compile: ``None`` (the
+    default, the production build) compiles the per-tick dispatch between
+    the two; ``"full"`` compiles the full multi-pass program alone
+    (equivalent to ``cfg.fast_path=False``); ``"fused"`` compiles the
+    2-pass fused program ALONE, with no dispatch guard — callers own the
+    precondition that every tick satisfies the dispatch predicate (no Join
+    broadcast, no suspicion activity), which bench's ``--fastpath-ab``
+    checks by bit-comparing against the dispatched build. The standalone
+    fused build exists for the A/B lane and the graftscan registry (its
+    pass structure is auditable in isolation); production always ships the
+    dispatched build.
+    """
+
+    det = cfg.deterministic
+    if _cut not in (None, "A", "c1", "c2", "c34", "G"):
+        # A typoed label would silently compile the normal full tick and a
+        # stage probe would bank a full-tick time as a phase-cut measurement.
+        raise ValueError(f"unknown _cut label {_cut!r}")
+    if telemetry and _cut is not None:
+        # _cut returns partial state with zeroed metrics — counters over a
+        # truncated tick would be meaningless numbers with real-looking names.
+        raise ValueError("telemetry=True is incompatible with a _cut probe")
+    if program not in (None, "full", "fused"):
+        raise ValueError(f"unknown program {program!r}")
+    if program == "fused" and _cut is not None:
+        raise ValueError("_cut probes truncate the full program only")
+
+    # The declarative twin of this module: the op graph for this build and
+    # the two planned programs the dispatch below composes. The cut labels
+    # and the dispatch predicate are DERIVED from the plan — exec.py's only
+    # private knowledge is the op bodies themselves (keyed by op name; a
+    # graph op without a body here fails the build-time check below, so the
+    # metadata cannot drift from the implementation).
+    graph = build_graph(cfg, faulty=faulty, telemetry=telemetry)
+    full_prog = plan(graph, "full")
+    fused_prog = plan(graph, "fused")
+    _check_programs(graph, full_prog, fused_prog)
+
+    # The closure is traced from ANOTHER module (runner.simulate's lax.scan /
+    # the jax.jit call sites in tests and scripts), which per-module
+    # reachability cannot see — the pragma keeps the KB2xx tracer rules live
+    # on the hottest function in the repo. The named scope labels the tick's
+    # ops in jax.profiler captures (name-stack metadata only — numerics and
+    # compiled-program identity are unchanged).
+    @jax.named_scope("kaboodle:tick")
+    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:  # graftlint: traced
+        n = st.state.shape[-1]
+        t = st.tick
+        idx = jnp.arange(n, dtype=jnp.int32)
+        eye = idx[:, None] == idx[None, :]
+        key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
+
+        S, T = st.state, st.timer
+        # Timer writes must stay in the timer's dtype (int32 default, int16
+        # in the memory-lean mode — see MEMORY_PLAN.md): a bare `t` in a
+        # where() would promote the whole [N, N] tensor to int32 and break
+        # the scan carry. Comparisons (t - T) still compute in int32.
+        tT = t.astype(T.dtype)
+        TMAX = int(jnp.iinfo(T.dtype).max)
+        lat, idv = st.latency, st.id_view
+        has_lat = lat is not None
+        has_idv = idv is not None
+        alive, never_b, last_b = st.alive, st.never_broadcast, st.last_broadcast
+        # Every in-tick identity write is the sender's *current* word — exactly
+        # what the envelope would carry this period (structs.rs:77-83).
+        id_row = st.identity[None, :]
+
+        # ---- churn: silent kill (Q8) + revive-with-reset (lockstep.revive) ----
+        if faulty:
+            alive = (alive & ~inp.kill) | inp.revive
+            rv = inp.revive
+            S = jnp.where(rv[:, None], jnp.where(eye, jnp.int8(KNOWN), jnp.int8(0)), S)
+            T = jnp.where(rv[:, None], jnp.where(eye, tT, jnp.zeros((), T.dtype)), T)
+            if has_lat:
+                lat = jnp.where(rv[:, None], jnp.nan, lat)
+            if has_idv:
+                idv = jnp.where(
+                    rv[:, None], jnp.where(eye, id_row, jnp.uint32(0)), idv
+                )
+            never_b = never_b | rv
+        else:
+            rv = jnp.zeros((n,), dtype=bool)
+
+        # ---- delivery gate for every message this tick ------------------------
+        # ok[s, d]: sender alive, receiver alive, same partition group, not
+        # dropped. The lockstep oracle's ``delivery_ok`` + aliveness checks.
+        # In fault-free mode the gate factors as alive[s] & alive[d], so no
+        # [N, N] matrix exists: edge checks are O(1) vector gathers
+        # (``ok_edge``) and the full-matrix consumers (join delivery) use the
+        # outer-product expression (``ok_outer``), which fuses.
+        if faulty:
+            ok = alive[:, None] & alive[None, :]
+            ok &= inp.partition[:, None] == inp.partition[None, :]
+            if inp.drop_ok is not None:
+                ok &= inp.drop_ok
+            else:
+                # The [N, N] uniform draw is the single most expensive op of a
+                # drop-free faulty tick — gate it on the (traced) rate so
+                # churn/partition-only scenarios skip the RNG entirely.
+                ok = jax.lax.cond(
+                    inp.drop_rate > 0,
+                    lambda ok: ok
+                    & (
+                        jax.random.uniform(key_drop, (n, n), dtype=jnp.float32)
+                        >= inp.drop_rate
+                    ),
+                    lambda ok: ok,
+                    ok,
+                )
+
+            def ok_edge(s, d):
+                return _gather_edge(ok, s, d)
+
+            def ok_outer():  # ok[s, d] as a full matrix (join/fail delivery)
+                return ok
+        else:
+
+            def ok_edge(s, d):
+                return alive[jnp.clip(s, 0)] & alive[jnp.clip(d, 0)]
+
+            def ok_outer():
+                return alive[:, None] & alive[None, :]
+
+        # ---- Phase-A row stats on the pre-tick snapshot ----------------------
+        # (the oracle's handle_suspected_peers iterates a snapshot taken at
+        # entry, kaboodle.rs:558-653). The fused path computes the membership
+        # count, the timed-out-suspect argmin, and proxy-candidate existence in
+        # one Pallas pass over (S, T); the jnp path spells the same formulas
+        # out (several fused XLA passes). Nothing writes S/T before the A2
+        # apply, so the snapshot is just an alias.
+        S0, T0 = S, T
+        age0 = t - T0
+        use_fused_susp = cfg.use_pallas_suspicion and pallas_suspicion_supported(n)
+        if use_fused_susp:
+            row_count0, jstar_pre, has_timed, has_cand_pre, wfip_any = fused_suspicion(
+                S, T, alive, t - cfg.ping_timeout_ticks
+            )
+        else:
+            # Only what the fast/slow dispatch pred and A1 need is computed
+            # here (the fewest sibling reductions over one (S, T) read); the
+            # slow-path-only stats — the escalation argmin and the proxy-
+            # candidate test — are recomputed inside _rest, off the fast
+            # ticks entirely.
+            row_count0 = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
+            has_timed = jnp.any(
+                alive[:, None] & (S0 == WAITING_FOR_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                ),
+                axis=-1,
+            )
+            wfip_any = jnp.any(
+                alive[:, None]
+                & (S0 == WAITING_FOR_INDIRECT_PING)
+                & (age0 >= cfg.ping_timeout_ticks),
+                axis=-1,
+            )
+            jstar_pre = has_cand_pre = None
+        # any(insta_remove) | any(escalate) == any(has_timed): the dispatch
+        # pred does not need the has_cand split.
+        any_a2 = jnp.any(wfip_any) | jnp.any(has_timed)
+
+        # Q6 insert stamp offset, shared by the join-gossip and anti-entropy
+        # reply inserts (0 = the epidemic-boot extension, config.py).
+        gossip_backdate = (
+            cfg.max_peer_share_age_ticks if cfg.backdate_gossip_inserts else 0
+        )
+        rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
+        u_row = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (n, n))
+        INF = jnp.int32(_I32MAX)
+
+        def fp_count(S_now, idv_now):
+            """Row fingerprints + membership counts at a point in the tick.
+
+            With identity views, each row hashes the identities it has actually
+            seen (engine.fingerprint() over its own records); otherwise the
+            global ``rec_hash`` vector (instant-identity fast mode). With
+            ``cfg.use_pallas_fp`` the whole pass (member test, hash, masked
+            sum, count) runs as one fused Pallas kernel — bit-exact."""
+            if cfg.use_pallas_fp and pallas_supported(n):
+                return fused_fp_count(S_now, idv_now if has_idv else rec_hash)
+            member = S_now > 0
+            if has_idv:
+                contrib = jnp.where(member, peer_record_hash(u_row, idv_now), jnp.uint32(0))
+            else:
+                contrib = jnp.where(member, rec_hash[None, :], jnp.uint32(0))
+            fp = jnp.sum(contrib, axis=-1, dtype=jnp.uint32)
+            return fp, jnp.sum(member, axis=-1, dtype=jnp.int32)
+
+        def apply_marks(S, T, lat, idv, mark):
+            """Q1 mark pass for one delivery wave: mark[d, s] == a datagram
+            from s reached d this wave. Latency EWMA sampled where the marked
+            entry was in a waiting state (kaboodle.rs:789-817, f32 like the
+            oracle); identity view refreshed from the envelope."""
+            if has_lat:
+                waiting = (S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)
+                sample = (t - T).astype(jnp.float32)
+                upd = jnp.where(
+                    jnp.isnan(lat),
+                    sample,
+                    jnp.float32(0.8) * sample + jnp.float32(0.2) * lat,
+                )
+                lat = jnp.where(mark & waiting, upd, lat)
+            if has_idv:
+                idv = jnp.where(mark, id_row, idv)
+            S = jnp.where(mark, jnp.int8(KNOWN), S)
+            T = jnp.where(mark, tT, T)
+            return S, T, lat, idv
+
+        def apply_marks_delta(S, T, lat, idv, mark):
+            """apply_marks + the exact (fp, count) delta the wave causes.
+
+            fp is a wraparound uint32 sum of per-member record-hash words, so
+            a wave's effect is an exact additive delta: a marked cell's
+            contribution becomes ``rec_hash[j]`` (the mark writes the sender's
+            current identity word — ``hash(j, id_row) == rec_hash[j]``), and
+            was ``hash(j, idv_old)`` if already a member, else 0. Summed in
+            the same modular group as fp_count, so ``fp_before + delta`` is
+            bit-equal to recomputing — letting steady-state ticks skip two
+            full fingerprint reads (the A/B in PERF.md round 4). Marks never
+            remove members, so the count delta is the new-member count.
+            """
+            member_b = S > 0
+            newm = mark & ~member_b
+            if has_idv:
+                old = jnp.where(
+                    member_b, peer_record_hash(u_row, idv), jnp.uint32(0)
+                )
+                dfp = jnp.sum(
+                    jnp.where(mark, rec_hash[None, :] - old, jnp.uint32(0)),
+                    axis=-1,
+                    dtype=jnp.uint32,
+                )
+            else:
+                dfp = jnp.sum(
+                    jnp.where(newm, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1,
+                    dtype=jnp.uint32,
+                )
+            dn = jnp.sum(newm, axis=-1, dtype=jnp.int32)
+            S, T, lat, idv = apply_marks(S, T, lat, idv, mark)
+            return S, T, lat, idv, dfp, dn
+
+        def _early_return(S, T, lat, idv):
+            """_cut exit: partial state, zeroed metrics (same pytree shape)."""
+            partial = MeshState(
+                state=S, timer=T, alive=alive, identity=st.identity,
+                never_broadcast=never_b, last_broadcast=last_b,
+                kpr_partner=st.kpr_partner, kpr_fp=st.kpr_fp, kpr_n=st.kpr_n,
+                tick=t + 1, key=key_next, latency=lat, id_view=idv,
+            )
+            metrics = TickMetrics(
+                messages_delivered=jnp.zeros((), jnp.int32),
+                converged=jnp.bool_(False),
+                agree_fraction=jnp.zeros((), jnp.float32),
+                mean_membership=jnp.zeros((), jnp.float32),
+                fingerprint_min=jnp.zeros((), jnp.uint32),
+                fingerprint_max=jnp.zeros((), jnp.uint32),
+            )
+            return partial, metrics
+
+        # ================= A. Active phase (kaboodle.rs:746-757) ==============
+        # A1: maybe_broadcast_join (kaboodle.rs:228-251): first call always
+        # broadcasts; afterwards only while lonely and rebroadcast-interval old.
+        # With broadcasts disabled (gossip boot) the whole block compiles out.
+        if cfg.join_broadcast_enabled:
+            lonely = row_count0 <= 1
+            join_b = alive & (
+                never_b | (lonely & ((t - last_b) >= cfg.rebroadcast_interval_ticks))
+            )
+            last_b = jnp.where(join_b, t, last_b)
+            never_b = never_b & ~join_b
+            any_join = jnp.any(join_b)
+        else:
+            join_b = jnp.zeros((n,), dtype=bool)
+            any_join = jnp.bool_(False)
+
+        # A4: manual pings (ping_addrs, kaboodle.rs:550-556): no state change at
+        # the sender. Self-pings and out-of-range targets are dropped at the
+        # transport (deviation D8, matching LockstepMesh._deliver_round's
+        # ``0 <= dest < n`` guard — without this, clamped gathers would fake
+        # an exchange with peer N-1). Shared by both tick branches.
+        man_tgt = jnp.where(
+            alive & (inp.manual_target != idx) & (inp.manual_target < n),
+            inp.manual_target,
+            -1,
+        )
+
+        def _anti_entropy(S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g):
+            """Call-G apply (kaboodle.rs:707-740), shared by both branches.
+
+            Requests only flow while fingerprints disagree, so every call-G
+            [N, N] pass — the marks, the share gather/insert, and the final
+            fingerprint read — is gated on a request actually being delivered:
+            on a converged steady-state tick nothing in here touches the
+            state and fp_f is exactly fp_g."""
+
+            def _g_apply(S, T, lat, idv):
+                mark_g = _col_mark(idx, partner, del_kpr)  # partner marks requester
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark_g)
+
+                # Filtered reply share (kaboodle.rs:483-501): Known peers heard
+                # from strictly within MAX_PEER_SHARE_AGE, excluding self (and
+                # the requester — enforced receiver-side as j != i, same
+                # effect). Computed post-marks, matching the oracle's two-pass
+                # delivery. Not capped (Q12). The share snapshot is taken
+                # before the requester-marks-partner write below (the oracle's
+                # two-pass order): a partner's own fresh call-G marks must not
+                # leak into the rows it shares this tick.
+                S_share, T_share = S, T
+
+                def _share_f():
+                    return (S_share == KNOWN) & ~eye & (
+                        (t - T_share) < cfg.max_peer_share_age_ticks
+                    )
+
+                if telemetry:
+                    # Records in the replies SENT this tick: a partner answers
+                    # every delivered request (del_kpr gates the send, not
+                    # del_rep — the reply's own delivery may still drop), and
+                    # the oracle's share additionally excludes the requester,
+                    # subtracted per edge so counts match its share lists.
+                    share_t = _share_f()
+                    share_cnt = jnp.sum(share_t, axis=-1, dtype=jnp.int32)
+                    ae_records = jnp.sum(
+                        jnp.where(
+                            del_kpr,
+                            share_cnt[jnp.clip(partner, 0)]
+                            - _gather_edge(share_t, partner, idx).astype(jnp.int32),
+                            0,
+                        ),
+                        dtype=jnp.int32,
+                    )
+                mark_rep = _row_mark(idx, partner, del_rep)  # requester marks partner
+                S = jnp.where(mark_rep, jnp.int8(KNOWN), S)
+                T = jnp.where(mark_rep, tT, T)
+
+                def _kpr_reply_insert(S, T, idv):
+                    share_f = share_t if telemetry else _share_f()
+                    srow = share_f[jnp.clip(partner, 0)]  # [N, N] gathered partner rows
+                    rep_ins = del_rep[:, None] & srow & ~eye & ~(S > 0)
+                    S2 = jnp.where(rep_ins, jnp.int8(KNOWN), S)
+                    T2 = jnp.where(rep_ins, tT - gossip_backdate, T)
+                    if has_idv:
+                        # The reply carries (addr, identity) records
+                        # (structs.rs:110); identity words resolve to the
+                        # peers' current identities (D-ID1, like the
+                        # join-gossip insert in _rest). Without this, a row
+                        # re-filled after a revive keeps placeholder words and
+                        # its fingerprint can never agree.
+                        idv = jnp.where(rep_ins, id_row, idv)
+                    return S2, T2, idv
+
+                S, T, idv = jax.lax.cond(
+                    jnp.any(del_rep),
+                    _kpr_reply_insert,
+                    lambda S, T, idv: (S, T, idv),
+                    S, T, idv,
+                )
+                fp_f, n_f = fp_count(S, idv)
+                if telemetry:
+                    return S, T, lat, idv, fp_f, n_f, ae_records
+                return S, T, lat, idv, fp_f, n_f
+
+            if telemetry:
+                return jax.lax.cond(
+                    jnp.any(del_kpr),
+                    _g_apply,
+                    lambda S, T, lat, idv: (
+                        S, T, lat, idv, fp_g, n_g, jnp.int32(0)
+                    ),
+                    S, T, lat, idv,
+                )
+            return jax.lax.cond(
+                jnp.any(del_kpr),
+                _g_apply,
+                lambda S, T, lat, idv: (S, T, lat, idv, fp_g, n_g),
+                S, T, lat, idv,
+            )
+
+        def _ae_phase01(fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt):
+            """Anti-entropy candidate phases 0-1, shared by both branches
+            (kaboodle.rs:707-740 take_sync_request order). Phase 0: last
+            tick's KnownPeersRequest senders (their candidates were recorded
+            before this tick's acks arrived); phase 1: this tick's call-2
+            direct + manual acks, sender == acked peer. Returns
+            ``(prio0, peer0, prio1, peer1)``; phases 2-3 are escalation-borne
+            and exist only in the full path."""
+            m0 = (st.kpr_partner[None, :] == idx[:, None]) & alive[:, None] & ~rv[:, None]
+            match0 = m0 & (st.kpr_fp[None, :] != fp_g[:, None]) & (
+                n_g[:, None] <= st.kpr_n[None, :]
+            )
+            prio0 = jnp.min(jnp.where(match0, idx[None, :], INF), axis=-1)
+            peer0 = prio0  # sender == candidate peer for KPR candidates
+
+            base1 = jnp.int32(n)
+            m_d = del_ack & (fp1[jnp.clip(ping_tgt, 0)] != fp_g) & (
+                n_g <= n1[jnp.clip(ping_tgt, 0)]
+            )
+            m_m = del_ack_man & (fp1[jnp.clip(man_tgt, 0)] != fp_g) & (
+                n_g <= n1[jnp.clip(man_tgt, 0)]
+            )
+            prio_d = jnp.where(m_d, base1 + ping_tgt, INF)
+            prio_m = jnp.where(m_m, base1 + man_tgt, INF)
+            prio1 = jnp.minimum(prio_d, prio_m)
+            peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
+            return prio0, peer0, prio1, peer1
+
+        def _finish(
+            S, T, lat, idv, kpr_partner_new, fp_g, n_g, fp_f, n_f, msgs,
+            counters=None,
+        ):
+            """Metrics + next-state assembly, shared by both branches.
+
+            In telemetry builds ``counters`` carries the branch's event
+            counts; the two pre/post-state counters — suspicions refuted
+            (WaitingForIndirectPing at S0 -> Known now) and armed timers
+            (waiting cells in alive rows at tick end) — are filled in here,
+            where both snapshots are in scope, and the per-member ``fp_f``
+            vector rides out as the flight-recorder digest plane."""
+            converged, fpa_min, fpa_max, n_alive = fingerprint_agreement(
+                alive, fp_f
+            )
+            agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
+
+            new_state = MeshState(
+                state=S,
+                timer=T,
+                alive=alive,
+                identity=st.identity,
+                never_broadcast=never_b,
+                last_broadcast=last_b,
+                kpr_partner=kpr_partner_new,
+                kpr_fp=fp_g,
+                kpr_n=n_g,
+                tick=t + 1,
+                key=key_next,
+                latency=lat,
+                id_view=idv,
+            )
+            metrics = TickMetrics(
+                messages_delivered=msgs,
+                converged=converged,
+                agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
+                # f32 accumulation: an int32 sum wraps once alive x members
+                # exceeds 2^31 (N > ~46,341 converged) — reachable now that
+                # the chunked twin executes N=65,536 ticks; keep the two
+                # kernels' metrics bit-comparable.
+                mean_membership=jnp.sum(jnp.where(alive, n_f, 0).astype(jnp.float32))
+                / jnp.maximum(n_alive, 1),
+                fingerprint_min=fpa_min,
+                fingerprint_max=fpa_max,
+            )
+            if telemetry:
+                counters = dataclasses.replace(
+                    counters,
+                    suspicions_refuted=jnp.sum(
+                        (S0 == WAITING_FOR_INDIRECT_PING) & (S == KNOWN),
+                        dtype=jnp.int32,
+                    ),
+                    armed_timers=jnp.sum(
+                        alive[:, None]
+                        & ((S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)),
+                        dtype=jnp.int32,
+                    ),
+                )
+                return new_state, TickTelemetry(
+                    metrics=metrics, counters=counters, fp=fp_f
+                )
+            return new_state, metrics
+
+        def _rest(S=S, T=T, lat=lat, idv=idv):
+            """The full program's tail: A2 suspicion handling onward, one
+            pass per cond-gated phase (plan(graph, "full")). Taken by every
+            tick where the planner-derived dispatch predicate fires (a Join
+            broadcast or suspicion activity — faulty or not), and by every
+            tick of ``fast_path=False`` / ``program="full"`` builds. The
+            default args freeze the post-churn/post-A1 tensors."""
+            # Slow-path-only phase-A stats (kaboodle.rs:558-653), recomputed
+            # here from the same pre-tick snapshot so fast ticks never pay
+            # for them. D1: escalate exactly one — the oldest timed-out
+            # WaitingForPing entry, ties toward lower index; proxy candidates
+            # are Known peers other than self (kaboodle.rs:595-605; the
+            # suspect itself is WaitingForPing, excluded).
+            if use_fused_susp:
+                jstar, has_cand = jstar_pre, has_cand_pre
+            else:
+                timed_wfp = alive[:, None] & (S0 == WAITING_FOR_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                )
+                tsel = jnp.where(timed_wfp, T0, TMAX)
+                min_t = jnp.min(tsel, axis=-1)
+                jstar_mask = timed_wfp & (T0 == min_t[:, None])
+                jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
+                jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
+                has_cand = jnp.any((S0 == KNOWN) & ~eye, axis=-1)
+            escalate = has_timed & has_cand
+            insta_remove = has_timed & ~has_cand  # no proxies -> drop (:599-605)
+            jstar_cell = idx[None, :] == jstar[:, None]
+            any_rem = jnp.any(wfip_any) | jnp.any(insta_remove)
+
+            # A2: handle_suspected_peers (kaboodle.rs:558-653) on the pre-tick
+            # snapshot. Escalations are rare (none at all in fault-free steady
+            # state), so the [N, N] gumbel + top_k proxy draw is gated; the
+            # zero indices in the skip branch are inert because proxies_valid
+            # is all-False then. The skip branch derives its shapes from the
+            # draw itself so the two branches cannot drift apart.
+            def _draw_proxies():
+                # The candidate matrix lives only inside this rare branch (the
+                # fused-suspicion path never materializes it outside).
+                known_cand = (S0 == KNOWN) & ~eye
+                return choose_k_members(known_cand, cfg.num_indirect_ping_peers, key_proxy, det)
+
+            proxies, proxies_valid = jax.lax.cond(
+                jnp.any(escalate),
+                _draw_proxies,
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), jax.eval_shape(_draw_proxies)
+                ),
+            )  # [N, k]
+            proxies_valid &= escalate[:, None]
+
+            # WaitingForIndirectPing timeouts -> removal (kaboodle.rs:617-627),
+            # judged on the same pre-tick snapshot (an entry escalated this
+            # tick is not removed this tick). The whole A2 write phase is a
+            # no-op on suspicion-free ticks, so the [N, N] write pass is gated
+            # out of them; the removal mask is rebuilt inside each gated
+            # consumer so it is never materialized on clean ticks.
+            def _a2_rem():
+                r = alive[:, None] & (S0 == WAITING_FOR_INDIRECT_PING) & (
+                    age0 >= cfg.ping_timeout_ticks
+                )
+                return r | (insta_remove[:, None] & jstar_cell)
+
+            def _a2_apply(S, T, lat):
+                rem = _a2_rem()
+                S = jnp.where(rem, jnp.int8(0), S)
+                if has_lat:
+                    # _remove drops the whole record: a re-learned peer starts
+                    # with no latency history (kaboodle.rs:643-644).
+                    lat = jnp.where(rem, jnp.nan, lat)
+                # The accompanying Failed broadcasts are inert in the reference
+                # (quirk Q3) — modeled only in intended-semantics mode below.
+                esc_cell = escalate[:, None] & jstar_cell
+                S = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), S)
+                T = jnp.where(esc_cell, tT, T)
+                return S, T, lat
+
+            S, T, lat = jax.lax.cond(
+                any_a2, _a2_apply, lambda S, T, lat: (S, T, lat), S, T, lat
+            )
+
+            # A3: ping_random_peer (kaboodle.rs:655-703) on the post-A2 state.
+            if cfg.use_pallas_oldest_k and pallas_oldest_k_supported(n):
+                # Fused path: eligibility + all k rounds in one pass over
+                # state/timer tiles — no [N, N] eligibility mask materialized.
+                kk = 1 if det else cfg.num_candidate_target_peers
+                cand_idx, cand_valid = fused_oldest_k(S, T, alive, kk)
+                ping_tgt = choose_among_candidates(cand_idx, cand_valid, key_ping, det)
+            else:
+                elig = alive[:, None] & (S == KNOWN) & ~eye
+                ping_tgt = choose_one_of_oldest_k(
+                    T, elig, cfg.num_candidate_target_peers, key_ping, det,
+                    method=cfg.oldest_k_method,
+                )
+            has_ping = ping_tgt >= 0
+            tgt_cell = _row_mark(idx, ping_tgt, has_ping)
+            S = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
+            T = jnp.where(tgt_cell, tT, T)
+
+            if _cut == "A":
+                return _early_return(S, T, lat, idv)
+
+            member_a = S > 0
+            row_count_a = jnp.sum(member_a, axis=-1, dtype=jnp.int32)
+
+            # ============= B. Broadcast delivery (kaboodle.rs:256-311) ========
+            # Join o accepted at r: Jm[r, o]. Receivers insert the joiner as
+            # Known(now) with the broadcast identity, preserving a prior
+            # latency (kaboodle.rs:284-304, :291-297).
+            if cfg.join_broadcast_enabled:
+                Jm = join_b[None, :] & ok_outer().T & ~eye  # [receiver, origin]
+                is_new_ro = Jm & ~member_a
+                S = jnp.where(Jm, jnp.int8(KNOWN), S)
+                T = jnp.where(Jm, tT, T)
+                if has_idv:
+                    idv = jnp.where(Jm, id_row, idv)
+            else:
+                Jm = jnp.zeros((n, n), dtype=bool)
+                is_new_ro = Jm
+
+            if not cfg.faithful_failed_broadcast:
+                # Failed(j) broadcast by i, delivered to r (r != j): remove j.
+                # Broadcasts resolve in origin order (the lockstep contract),
+                # so a same-tick Join(j) wins only against Failed origins
+                # i < j; any delivering Failed origin i > j removes j after
+                # the re-insert. (When Join(j) was not delivered at r, any
+                # Failed origin removes.) O(N^3) matmuls, so skipped on
+                # removal-free ticks like the gossip union below.
+                def _fail_del(_):
+                    rem = _a2_rem()
+                    rem_gt = rem & (idx[:, None] > idx[None, :])  # [i, j]: i > j
+                    fail_gt = _bool_matmul(ok_outer().T, rem_gt)  # [r, j]
+                    fail_any = _bool_matmul(ok_outer().T, rem)  # [r, j]
+                    return ~eye & jnp.where(Jm, fail_gt, fail_any)
+
+                fail_del = jax.lax.cond(
+                    any_rem,
+                    _fail_del,
+                    lambda _: jnp.zeros((n, n), dtype=bool),
+                    operand=None,
+                )
+                S = jnp.where(fail_del, jnp.int8(0), S)
+                if has_lat:
+                    lat = jnp.where(fail_del, jnp.nan, lat)
+
+            # Join responses (kaboodle.rs:333-392): r replies to each *new*
+            # joiner with probability max(1, 100-n^2)% where n tracks the
+            # sequentially growing map (cumulative inserts in origin order —
+            # exact parity), and the accepted replies union into a gossip
+            # share at the joiner. The whole block — [N, N] cumsums, the
+            # Bernoulli draw, and the two boolean matmuls — is gated on a
+            # join actually happening this tick (steady-state ticks have
+            # none); the skip branch's all-False outputs are exactly what the
+            # formulas produce with join_b all-False. With broadcasts
+            # compiled out there is never a join, so the gate is static.
+            def _join_replies():
+                n_after = row_count_a[:, None] + jnp.cumsum(is_new_ro.astype(jnp.int32), axis=1)
+                reply_p = broadcast_reply_prob(n_after)
+                bern = bernoulli_matrix(key_bern, reply_p, (n, n), det)
+                reply = is_new_ro & bern  # [r, o]
+                reply_del_ = reply & ok_outer()  # response unicast r -> o gated like any message
+
+                # Gossip union at joiner o (deliverable in call 2): the reply
+                # share is r's map at reply time = start-of-round map +
+                # joiners accepted with origin index <= o (the oracle's
+                # sequential processing order):
+                #   gossip[o, j] = OR_r reply_del[r,o] & (M_a[r,j] | (Jm[r,j] & j<=o))
+                def _union():
+                    share_base = member_a
+                    if cfg.max_share_peers and n > cfg.max_share_peers:
+                        # D5: cap to lowest-index members of the start-of-round map.
+                        within_cap = (
+                            jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cfg.max_share_peers
+                        )
+                        share_base = member_a & within_cap
+                    term1 = _bool_matmul(reply_del_.T, share_base)  # [o, j]
+                    term2 = _bool_matmul(reply_del_.T, Jm)  # [o, j]: OR_r reply_del[r,o] & Jm[r,j]
+                    tri = idx[None, :] <= idx[:, None]  # j <= o
+                    return term1 | (term2 & tri)
+
+                # The O(N^3) union contracts reply_del: gate it on a reply
+                # actually existing, not merely on a Join broadcast — a
+                # rebroadcast into an already-full mesh (every survivor is
+                # lonely-flagged never_broadcast at a fresh converged init,
+                # and every revive re-announces) produces NO new joiners and
+                # so no replies, and the dense contraction on all-False
+                # operands was the dominant cost of exactly those ticks
+                # (the 8,610 s revive tick in SCALE_PROOF.md).
+                gossip_ = jax.lax.cond(
+                    jnp.any(reply_del_),
+                    _union,
+                    lambda: jnp.zeros((n, n), dtype=bool),
+                )
+                if telemetry:
+                    # Records in the join-response shares SENT (``reply``, not
+                    # ``reply_del_`` — the response unicast may still drop).
+                    # Uncapped, the share to joiner o is r's sequential map at
+                    # reply time, whose size is exactly ``n_after`` (Q5/D9:
+                    # start-of-round map union joins <= o). Over the D5 cap
+                    # the share is the capped base plus — uncapped — this
+                    # round's joiners not already in it, exactly the oracle's
+                    # _share_snapshot_join arithmetic.
+                    if cfg.max_share_peers:
+                        cap = jnp.int32(cfg.max_share_peers)
+                        within_cap_t = (
+                            jnp.cumsum(member_a.astype(jnp.int32), axis=1) <= cap
+                        )
+                        base_c = member_a & within_cap_t
+                        clen = jnp.minimum(row_count_a, cap)[:, None] + jnp.cumsum(
+                            (Jm & ~base_c).astype(jnp.int32), axis=1
+                        )
+                        rec_cnt = jnp.where(n_after <= cap, n_after, clen)
+                    else:
+                        rec_cnt = n_after
+                    join_records_ = jnp.sum(
+                        jnp.where(reply, rec_cnt, 0), dtype=jnp.int32
+                    )
+                    return reply_del_, gossip_, join_records_
+                return reply_del_, gossip_
+
+            if cfg.join_broadcast_enabled:
+                if telemetry:
+                    reply_del, gossip, join_records = jax.lax.cond(
+                        any_join,
+                        _join_replies,
+                        lambda: (
+                            jnp.zeros((n, n), dtype=bool),
+                            jnp.zeros((n, n), dtype=bool),
+                            jnp.int32(0),
+                        ),
+                    )
+                else:
+                    reply_del, gossip = jax.lax.cond(
+                        any_join,
+                        _join_replies,
+                        lambda: (jnp.zeros((n, n), dtype=bool), jnp.zeros((n, n), dtype=bool)),
+                    )
+            else:
+                reply_del = gossip = jnp.zeros((n, n), dtype=bool)
+                join_records = jnp.int32(0)
+
+            # ============= Call 1: Pings + PingRequests =======================
+            ok_ping = has_ping & ok_edge(idx, ping_tgt)
+            ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
+            del_pr = proxies_valid & ok_edge(idx[:, None], proxies)  # [N, k]
+
+            # mark1[dest, sender]: dense one-hot compares (no scatter) — each
+            # term fuses into apply_marks' where pass. The proxy terms are
+            # all-False on escalation-free ticks but cost only fused compares,
+            # not a gather.
+            mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
+            for kk in range(proxies.shape[-1]):
+                mark1 |= _col_mark(idx, proxies[:, kk], del_pr[:, kk])
+            # Base fingerprint once (post-A3: the A3 WaitingForPing write moves
+            # no membership and no identity word, so this equals the pre-mark1
+            # fp); every later fp point derives by exact per-wave deltas on
+            # the fast path, with full recomputes only inside the
+            # join/escalation branches.
+            fp0, n0 = fp_count(S, idv)
+            S, T, lat, idv, dfp1, dn1 = apply_marks_delta(S, T, lat, idv, mark1)
+            fp1, n1 = fp0 + dfp1, n0 + dn1
+
+            if _cut == "c1":
+                return _early_return(S, T, lat, idv)
+
+            # Queued by call-1 dispatch: direct Acks (kaboodle.rs:513-532) and
+            # the proxies' Pings to the suspect (kaboodle.rs:533-545).
+            del_ack = ok_ping & ok_edge(ping_tgt, idx)  # tgt -> pinger
+            del_ack_man = ok_man & ok_edge(man_tgt, idx)
+            ok_p2x = ok_edge(proxies, jstar[:, None])  # proxy -> suspect
+            del_pping = del_pr & ok_p2x  # [N, k]
+
+            # ============= Call 2: Acks, proxy Pings, join responses ==========
+            mark2 = _row_mark(idx, ping_tgt, del_ack)  # pinger marks target
+            mark2 |= _row_mark(idx, man_tgt, del_ack_man)
+            mark2 |= reply_del.T  # joiner marks join-responder
+            # Suspect-marks-proxy scatters on BOTH dims (jstar rows x proxy
+            # cols), so it has no one-hot form; it is escalation-only, so gate
+            # the scatter out of steady-state ticks.
+            mark2 |= jax.lax.cond(
+                jnp.any(escalate),
+                lambda: _scatter_or(
+                    jnp.zeros((n, n), dtype=bool),
+                    jnp.broadcast_to(jstar[:, None], proxies.shape),
+                    proxies,
+                    del_pping,
+                ),
+                lambda: jnp.zeros((n, n), dtype=bool),
+            )
+            S, T, lat, idv, dfp2, dn2 = apply_marks_delta(S, T, lat, idv, mark2)
+
+            # Gossip-learned peers insert back-dated (Q6) where still unknown,
+            # with identity words resolved to the peers' current identities
+            # (deviation D-ID1 — shared with the lockstep oracle; the native
+            # engine carries the sharer's view faithfully).
+            if cfg.join_broadcast_enabled:
+
+                def _gossip_insert(S, T, idv):
+                    gossip_new = gossip & ~(S > 0)
+                    S = jnp.where(gossip_new, jnp.int8(KNOWN), S)
+                    T = jnp.where(gossip_new, tT - gossip_backdate, T)
+                    if has_idv:
+                        idv = jnp.where(gossip_new, id_row, idv)
+                    return S, T, idv
+
+                S, T, idv = jax.lax.cond(
+                    any_join, _gossip_insert, lambda S, T, idv: (S, T, idv), S, T, idv
+                )
+
+            # fp2/n2 feed only the indirect-ping ack payloads (call-3 acks at
+            # proxies, call-4 forwards) — every consumer is masked by an
+            # escalation-derived delivery, so the whole O(N^2) hash pass is
+            # gated off on escalation-free ticks (all of fault-free steady
+            # state).
+            S_2, idv_2 = S, idv
+            fp2, n2 = jax.lax.cond(
+                jnp.any(escalate),
+                lambda: fp_count(S_2, idv_2),
+                lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
+            )
+
+            if _cut == "c2":
+                return _early_return(S, T, lat, idv)
+
+            # Queued: the suspect's Acks back to the proxies.
+            del_pack = del_pping & ok_edge(jstar[:, None], proxies)  # [N, k]
+
+            # Coincidence forwarding (kaboodle.rs:418-443 pop semantics): if
+            # proxy p's own direct or manual ping this tick targeted the same
+            # suspect, p's call-2 Ack for it pops the curious entry and
+            # forwards fp1-payload Acks in call 3; the call-3 proxy Ack then
+            # finds curious empty.
+            p_tgt = ping_tgt[jnp.clip(proxies, 0)]  # [N, k] the proxies' own ping targets
+            p_man = man_tgt[jnp.clip(proxies, 0)]
+            p_got_direct = del_ack[jnp.clip(proxies, 0)]
+            p_got_man = del_ack_man[jnp.clip(proxies, 0)]
+            pop_hit = ((p_tgt == jstar[:, None]) & p_got_direct) | (
+                (p_man == jstar[:, None]) & p_got_man
+            )
+            fwd_c = del_pr & pop_hit  # proxy forwards its call-2 ack payload (fp1)
+            del_fwd_c = fwd_c & ok_edge(proxies, idx[:, None])  # p -> suspector
+
+            # Proxy forwards the suspect's Ack (fp2 payload) in call 4 unless
+            # the curious entry was already popped by the call-2 coincidence.
+            fwd = del_pack & ~pop_hit
+            del_fwd = fwd & ok_edge(proxies, idx[:, None])  # [N, k] p -> suspector
+
+            # ======== Calls 3 + 4: escalation-only delivery waves =============
+            # Call 3: suspect Acks at proxies; call 4: forwarded Acks. Every
+            # datagram in these waves descends from an escalation this tick,
+            # so the mark scatters and full-matrix where-passes are gated out
+            # of escalation-free ticks (all of fault-free steady state).
+            def _calls34(S, T, lat, idv):
+                mark3 = jnp.zeros((n, n), dtype=bool)
+                mark3 = _scatter_or(
+                    mark3, proxies, jnp.broadcast_to(jstar[:, None], proxies.shape), del_pack
+                )  # proxy marks suspect — the proxy's own view resurrects (Q1)
+                mark3 = _scatter_or(
+                    mark3, idx[:, None], proxies, del_fwd_c
+                )  # suspector marks pinger-proxy
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark3)
+
+                # Q11 (faithful_indirect_ack): the forwarded Ack's *sender* is
+                # the proxy, so the suspector marks the proxy — the suspect
+                # stays WaitingForIndirectPing (kaboodle.rs:408-415 applies to
+                # the sender).
+                mark4 = jnp.zeros((n, n), dtype=bool)
+                mark4 = _scatter_or(mark4, idx[:, None], proxies, del_fwd)
+                S, T, lat, idv = apply_marks(S, T, lat, idv, mark4)
+                if not cfg.faithful_indirect_ack:
+                    # Intended-SWIM mode: a forwarded ack clears the suspect too.
+                    cleared = jnp.any(del_fwd | del_fwd_c, axis=-1)
+                    clr_cell = cleared[:, None] & jstar_cell & (S > 0)
+                    S = jnp.where(clr_cell, jnp.int8(KNOWN), S)
+                    T = jnp.where(clr_cell, tT, T)
+                return S, T, lat, idv
+
+            S, T, lat, idv = jax.lax.cond(
+                jnp.any(escalate),
+                _calls34,
+                lambda S, T, lat, idv: (S, T, lat, idv),
+                S, T, lat, idv,
+            )
+
+            if _cut == "c34":
+                return _early_return(S, T, lat, idv)
+
+            # ============= G. Anti-entropy (kaboodle.rs:707-740) ==============
+            # On ticks with no join and no escalation, nothing touched the
+            # state between mark1 and here except mark2, so fp_g is the exact
+            # delta chain; the join-gossip / calls-3-4 branches fall back to a
+            # full recompute (they flip memberships with their own masks).
+            S_g, idv_g = S, idv
+            fp_g, n_g = jax.lax.cond(
+                any_join | jnp.any(escalate),
+                lambda: fp_count(S_g, idv_g),
+                lambda: (fp1 + dfp2, n1 + dn2),
+            )
+
+            # Candidate priority = phase_base + sender index; first match wins
+            # (take_sync_request scans in arrival order). Match condition:
+            # their_fp != our_fp and our_n <= their_n (kaboodle.rs:717-726).
+            prio0, peer0, prio1, peer1 = _ae_phase01(
+                fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt
+            )
+
+            # Phase 2 (call-3 acks): suspect acks at proxies (sender = suspect)
+            # and coincidence forwards at suspectors (sender = pinger-proxy).
+            base2 = jnp.int32(2 * n)
+            x_fp2 = fp2[jnp.clip(jstar, 0)]  # [N] suspect's fp2 per suspector row
+            x_n2 = n2[jnp.clip(jstar, 0)]
+            # at proxy P: candidate (X, fp2[X], n2[X]) — scatter-min over edges.
+            m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
+                n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
+            )
+            prio_proxy = jnp.full((n,), INF, dtype=jnp.int32).at[jnp.clip(proxies, 0)].min(
+                jnp.where(m_px, base2 + jstar[:, None], INF)
+            )
+            peer_proxy = prio_proxy - base2  # sender == X == candidate peer
+            # at suspector s: candidate (X, fp1[X], n1[X]) via coincidence forward.
+            x_fp1 = fp1[jnp.clip(jstar, 0)]
+            x_n1 = n1[jnp.clip(jstar, 0)]
+            m_cf = del_fwd_c & (x_fp1[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n1[:, None])
+            prio_coinc = jnp.min(jnp.where(m_cf, base2 + proxies, INF), axis=-1)
+            prio2 = jnp.minimum(prio_proxy, prio_coinc)
+            peer2 = jnp.where(prio_proxy <= prio_coinc, peer_proxy, jstar)
+
+            # Phase 3 (call-4 forwarded acks): candidate (X, fp2[X], n2[X]),
+            # sender = forwarding proxy.
+            base3 = jnp.int32(3 * n)
+            m_f = del_fwd & (x_fp2[:, None] != fp_g[:, None]) & (n_g[:, None] <= x_n2[:, None])
+            prio3 = jnp.min(jnp.where(m_f, base3 + proxies, INF), axis=-1)
+            peer3 = jstar
+
+            best = jnp.minimum(jnp.minimum(prio0, prio1), jnp.minimum(prio2, prio3))
+            partner = jnp.where(
+                best == prio0,
+                peer0,
+                jnp.where(best == prio1, peer1, jnp.where(best == prio2, peer2, peer3)),
+            ).astype(jnp.int32)
+            has_req = (best != INF) & alive
+            partner = jnp.where(has_req, partner, -1)
+
+            # KnownPeersRequest i -> partner, payload (fp_g[i], n_g[i]).
+            del_kpr = has_req & ok_edge(idx, partner)
+            del_rep = del_kpr & ok_edge(partner, idx)  # partner -> requester
+
+            if _cut == "G":
+                return _early_return(S, T, lat, idv)
+
+            if telemetry:
+                S, T, lat, idv, fp_f, n_f, ae_records = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+            else:
+                S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+
+            msgs = (
+                jnp.sum(ok_ping, dtype=jnp.int32)
+                + jnp.sum(ok_man, dtype=jnp.int32)
+                + jnp.sum(del_pr, dtype=jnp.int32)
+                + jnp.sum(del_ack, dtype=jnp.int32)
+                + jnp.sum(del_ack_man, dtype=jnp.int32)
+                + jnp.sum(del_pping, dtype=jnp.int32)
+                + jnp.sum(reply_del, dtype=jnp.int32)
+                + jnp.sum(del_pack, dtype=jnp.int32)
+                + jnp.sum(del_fwd_c, dtype=jnp.int32)
+                + jnp.sum(del_fwd, dtype=jnp.int32)
+                + jnp.sum(del_kpr, dtype=jnp.int32)
+                + jnp.sum(del_rep, dtype=jnp.int32)
+            )
+            counters = None
+            if telemetry:
+                # A2 removals (WFIP timeouts + no-proxy insta-removes),
+                # recomputed from the pre-tick snapshot only on ticks where
+                # A2 fired — _a2_rem's two terms are disjoint (an insta row's
+                # jstar cell is a timed-out WaitingForPing, never WFIP).
+                deaths = jax.lax.cond(
+                    any_a2,
+                    lambda: jnp.sum(
+                        alive[:, None]
+                        & (S0 == WAITING_FOR_INDIRECT_PING)
+                        & (age0 >= cfg.ping_timeout_ticks),
+                        dtype=jnp.int32,
+                    )
+                    + jnp.sum(insta_remove, dtype=jnp.int32),
+                    lambda: jnp.int32(0),
+                )
+                counters = ProtocolCounters(
+                    pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
+                    + jnp.sum(man_tgt >= 0, dtype=jnp.int32)
+                    + jnp.sum(del_pr, dtype=jnp.int32),
+                    acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
+                    + jnp.sum(ok_man, dtype=jnp.int32)
+                    + jnp.sum(del_pping, dtype=jnp.int32)
+                    + jnp.sum(fwd, dtype=jnp.int32)
+                    + jnp.sum(fwd_c, dtype=jnp.int32),
+                    ping_reqs_sent=jnp.sum(proxies_valid, dtype=jnp.int32),
+                    suspicions_raised=jnp.sum(escalate, dtype=jnp.int32),
+                    suspicions_refuted=jnp.int32(0),  # filled by _finish
+                    deaths_declared=deaths,
+                    joins_disseminated=jnp.sum(Jm, dtype=jnp.int32),
+                    gossip_bytes=jnp.uint32(RECORD_BYTES)
+                    * (ae_records + join_records).astype(jnp.uint32),
+                    armed_timers=jnp.int32(0),  # filled by _finish
+                )
+            return _finish(
+                S, T, lat, idv, jnp.where(del_kpr, partner, -1),
+                fp_g, n_g, fp_f, n_f, msgs, counters,
+            )
+
+        def _fast(S=S, T=T, lat=lat, idv=idv):
+            """The fused program: plan(graph, "fused")'s draw + update
+            passes, for ticks with no Join broadcast and no suspicion
+            activity (the planner-derived dispatch pred is False). Faulty
+            builds take it too since the phase-graph refactor: churn and
+            the delivery-gate matrix are prologue ops, and drops/partitions
+            flow through the same ``ok_edge`` gathers the masks compose.
+
+            On these ticks A2 is a no-op, there are no proxies, no join
+            replies, no gossip inserts, and calls 3-4 carry nothing — the
+            surviving traffic is the A3 ping, manual pings, their call-2
+            acks, and the anti-entropy exchange, all with masks derived from
+            O(N) vectors (one-hot compares). With no cond boundary between
+            the reads and the writes, XLA fuses the whole update into one
+            composed write chain over (S, T): the tick's [N, N] traffic is
+            the phase-A stats read (above), the eligibility/draw read, and
+            this one read+write — vs ~9 materialized sweeps through the full
+            path (the round-4 on-TPU decomposition, PERF.md). Bit-exact with
+            ``_rest`` on every tick where the pred is False
+            (tests/test_fast_path.py fuzzes the fault-free equivalence;
+            tests/test_phasegraph.py pins the faulty-build dispatch)."""
+            # A3 on the unchanged state (A2 was a no-op this tick).
+            elig = alive[:, None] & (S == KNOWN) & ~eye
+            ping_tgt = choose_one_of_oldest_k(
+                T, elig, cfg.num_candidate_target_peers, key_ping, det,
+                method=cfg.oldest_k_method,
+            )
+            has_ping = ping_tgt >= 0
+
+            # All of this tick's O(N) delivery plumbing, before any [N, N]
+            # write exists.
+            ok_ping = has_ping & ok_edge(idx, ping_tgt)
+            ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
+            del_ack = ok_ping & ok_edge(ping_tgt, idx)
+            del_ack_man = ok_man & ok_edge(man_tgt, idx)
+
+            # Composed single-pass update. The sequential semantics are
+            # A3 write -> call-1 marks (+deltas) -> call-2 marks (+deltas),
+            # which the full path expresses as three separate write passes;
+            # here every mask is a one-hot outer form over the vectors above,
+            # so the final cell value and both waves' exact (fp, count)
+            # deltas are pure elementwise functions of the ORIGINAL (S, T)
+            # plus those vectors — one read, one write, sibling reductions,
+            # no intermediate [N, N] tensor for XLA to materialize.
+            # Equivalences used (all pinned by tests/test_fast_path.py):
+            #   - A3 changes neither membership (KNOWN -> WaitingForPing,
+            #     both members) nor identity words, so fp0/n0 read the
+            #     original S exactly as the full path's post-A3 fp_count;
+            #   - wave-1/wave-2 overlap (mutual pings: cell (i, j) marked by
+            #     j's ping in wave 1 and j's ack in wave 2) resolves by
+            #     membership-after-wave-1 = member0 | mark1, matching the
+            #     chained apply_marks_delta;
+            #   - marks write (KNOWN, now, sender's current identity) in both
+            #     waves, so last-writer composition is order-free.
+            tgt_cell = _row_mark(idx, ping_tgt, has_ping)
+            mark1 = _col_mark(idx, ping_tgt, ok_ping) | _col_mark(idx, man_tgt, ok_man)
+            mark2 = _row_mark(idx, ping_tgt, del_ack) | _row_mark(idx, man_tgt, del_ack_man)
+            markK = mark1 | mark2
+
+            member0 = S > 0
+            n0 = jnp.sum(member0, axis=-1, dtype=jnp.int32)
+            member1 = member0 | mark1
+            dn1 = jnp.sum(mark1 & ~member0, axis=-1, dtype=jnp.int32)
+            dn2 = jnp.sum(mark2 & ~member1, axis=-1, dtype=jnp.int32)
+            if has_idv:
+                old_hash = jnp.where(
+                    member0, peer_record_hash(u_row, idv), jnp.uint32(0)
+                )
+                fp0 = jnp.sum(old_hash, axis=-1, dtype=jnp.uint32)
+                dfp1 = jnp.sum(
+                    jnp.where(mark1, rec_hash[None, :] - old_hash, jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                hash1 = jnp.where(mark1, rec_hash[None, :], old_hash)
+                dfp2 = jnp.sum(
+                    jnp.where(mark2, rec_hash[None, :] - hash1, jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                idv = jnp.where(markK, id_row, idv)
+            else:
+                fp0 = jnp.sum(
+                    jnp.where(member0, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                dfp1 = jnp.sum(
+                    jnp.where(mark1 & ~member0, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+                dfp2 = jnp.sum(
+                    jnp.where(mark2 & ~member1, rec_hash[None, :], jnp.uint32(0)),
+                    axis=-1, dtype=jnp.uint32,
+                )
+            if has_lat:
+                # Wave-ordered EWMA sampling, composed: wave 1 samples where
+                # the post-A3 state was waiting; wave 2 where the post-wave-1
+                # state still was (a wave-1 mark clears it to Known).
+                S_a3 = jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S)
+                T_a3 = jnp.where(tgt_cell, tT, T)
+                waiting1 = (S_a3 == WAITING_FOR_PING) | (
+                    S_a3 == WAITING_FOR_INDIRECT_PING
+                )
+                sample1 = (t - T_a3).astype(jnp.float32)
+                upd1 = jnp.where(
+                    jnp.isnan(lat), sample1,
+                    jnp.float32(0.8) * sample1 + jnp.float32(0.2) * lat,
+                )
+                lat1 = jnp.where(mark1 & waiting1, upd1, lat)
+                S_1 = jnp.where(mark1, jnp.int8(KNOWN), S_a3)
+                T_1 = jnp.where(mark1, tT, T_a3)
+                waiting2 = (S_1 == WAITING_FOR_PING) | (
+                    S_1 == WAITING_FOR_INDIRECT_PING
+                )
+                sample2 = (t - T_1).astype(jnp.float32)
+                upd2 = jnp.where(
+                    jnp.isnan(lat1), sample2,
+                    jnp.float32(0.8) * sample2 + jnp.float32(0.2) * lat1,
+                )
+                lat = jnp.where(mark2 & waiting2, upd2, lat1)
+            S = jnp.where(
+                markK, jnp.int8(KNOWN),
+                jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), S),
+            )
+            T = jnp.where(markK | tgt_cell, tT, T)
+            fp1, n1 = fp0 + dfp1, n0 + dn1
+            fp_g, n_g = fp1 + dfp2, n1 + dn2
+
+            # G: anti-entropy candidates — phases 0 and 1 only (phases 2-3
+            # are escalation-borne and there is none). The phase-2/3
+            # priorities are INF in _rest on these ticks, so the minimum and
+            # the selected partner agree exactly.
+            prio0, peer0, prio1, peer1 = _ae_phase01(
+                fp_g, n_g, fp1, n1, del_ack, del_ack_man, ping_tgt
+            )
+
+            best = jnp.minimum(prio0, prio1)
+            partner = jnp.where(best == prio0, peer0, peer1).astype(jnp.int32)
+            has_req = (best != INF) & alive
+            partner = jnp.where(has_req, partner, -1)
+            del_kpr = has_req & ok_edge(idx, partner)
+            del_rep = del_kpr & ok_edge(partner, idx)
+
+            if telemetry:
+                S, T, lat, idv, fp_f, n_f, ae_records = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+            else:
+                S, T, lat, idv, fp_f, n_f = _anti_entropy(
+                    S, T, lat, idv, partner, del_kpr, del_rep, fp_g, n_g
+                )
+            msgs = (
+                jnp.sum(ok_ping, dtype=jnp.int32)
+                + jnp.sum(ok_man, dtype=jnp.int32)
+                + jnp.sum(del_ack, dtype=jnp.int32)
+                + jnp.sum(del_ack_man, dtype=jnp.int32)
+                + jnp.sum(del_kpr, dtype=jnp.int32)
+                + jnp.sum(del_rep, dtype=jnp.int32)
+            )
+            counters = None
+            if telemetry:
+                # Fast ticks carry no escalation, no join, no A2 removal —
+                # the event counters those feed are structurally zero here
+                # (the _rest formulas reduce to exactly these on such ticks,
+                # so the lax.cond dispatch cannot make counters diverge).
+                counters = ProtocolCounters(
+                    pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
+                    + jnp.sum(man_tgt >= 0, dtype=jnp.int32),
+                    acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
+                    + jnp.sum(ok_man, dtype=jnp.int32),
+                    ping_reqs_sent=jnp.int32(0),
+                    suspicions_raised=jnp.int32(0),
+                    suspicions_refuted=jnp.int32(0),  # filled by _finish
+                    deaths_declared=jnp.int32(0),
+                    joins_disseminated=jnp.int32(0),
+                    gossip_bytes=jnp.uint32(RECORD_BYTES)
+                    * ae_records.astype(jnp.uint32),
+                    armed_timers=jnp.int32(0),  # filled by _finish
+                )
+            return _finish(
+                S, T, lat, idv, jnp.where(del_kpr, partner, -1),
+                fp_g, n_g, fp_f, n_f, msgs, counters,
+            )
+
+        # ---- dispatch ---------------------------------------------------------
+        # The planner decides: the fused program is bit-exact exactly when
+        # every pruned op is inactive, and the predicate below is the
+        # disjunction of the pruned ops' declared activity terms (plan.py's
+        # ``pred_terms`` — any_a2, any_join; with broadcasts compiled out
+        # only any_a2 remains). Since the phase-graph refactor the dispatch
+        # covers FAULTY builds too: churn and the delivery-gate matrix are
+        # prologue ops shared by both branches, so quiet faulty ticks (no
+        # suspicion, no join — the vast majority of every fault scenario's
+        # steady span) take the 2-pass fused program instead of paying the
+        # full path's ~9 cond-serialized sweeps. _cut probes always time
+        # the full path; ``program`` pins one branch for A/B and audit.
+        if program == "fused":
+            return _fast()
+        use_fast = cfg.fast_path and _cut is None and program is None
+        if not use_fast:
+            return _rest()
+        pred_vals = {"any_a2": any_a2, "any_join": any_join}
+        pred = pred_vals[fused_prog.pred_terms[0]]
+        for term in fused_prog.pred_terms[1:]:
+            pred = pred | pred_vals[term]
+        return jax.lax.cond(pred, _rest, _fast)
+
+    # Program metadata for derived consumers: the telemetry trace exporter's
+    # per-phase slices, the graftscan registry's entry descriptions, and the
+    # phasegraph dryrun all read the planned pass structure from here.
+    tick.graph = graph
+    tick.programs = {"full": full_prog, "fused": fused_prog}
+    return tick

@@ -1,0 +1,310 @@
+"""The phase-op vocabulary: every SWIM tick phase as declarative metadata.
+
+One :class:`PhaseOp` per protocol phase (the lockstep round letters of
+sim/kernel.py's docstring), declaring
+
+- the persistent state **fields** it reads and writes (``MeshState`` plane
+  names — the planner validates fusion legality against these),
+- the tick-local values it **gives** and **takes** (the dataflow between
+  ops inside one tick — the planner validates produce-before-consume),
+- its **activity** mask: the traced predicate under which the op does real
+  work. Ops whose activity is guaranteed False by the fused-dispatch
+  predicate are *pruned* from the fused program; the predicate itself is
+  derived from the pruned ops' ``pred_term`` declarations (plan.py).
+- ``mask_rank``: 1 if every write mask the op applies is derivable from
+  O(N) vectors (one-hot outer compares — foldable into a composed where
+  chain), 2 if it needs an [N, N] intermediate (matmuls, scatters on both
+  dims) and therefore can never fold,
+- its **span** fate inside a warp quiescent span: ``live`` (still runs),
+  ``degenerate`` (collapses to the timer-restamp / latency-decay /
+  ledger-fixed-point form the leap batches), or ``invariant`` (provably a
+  no-op — horizon.py's quiescence predicate is exactly the conjunction of
+  these invariance conditions).
+
+This module is pure metadata — no jax imports — so the planner and its
+tests run at AST-adjacent cost. The executable bodies live in exec.py
+(dense/fused), blocked.py (chunked) and span.py (leap), keyed by op name;
+tests/test_phasegraph.py pins that every op in a planned program has
+exactly one implementing pass in each derived engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Persistent MeshState planes (state fields ops may read/write). "S"/"T"
+# are the [N, N] membership-state and timer matrices; the rest are O(N).
+FIELDS = (
+    "S", "T", "lat", "idv", "alive", "identity", "never_b", "last_b",
+    "kpr_partner", "kpr_fp", "kpr_n", "tick", "key",
+)
+
+# TickInputs planes (per-tick scenario inputs).
+INPUTS = ("kill", "revive", "partition", "drop_rate", "drop_ok", "manual_target")
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseOp:
+    """One composable tick phase (see module docstring for the vocabulary).
+
+    ``stage`` splits the tick at the dispatch boundary: ``prologue`` ops run
+    unconditionally before the fused/full branch select (churn, the delivery
+    gate, the phase-A stats the predicate itself needs); ``tail`` ops are
+    what the dispatch chooses between. ``cut`` is the stage-probe label
+    (`make_tick_fn(_cut=...)`) that truncates the full program right after
+    this op.
+    """
+
+    name: str
+    phase: str  # lockstep round letter: "A", "B", "1".."4", "G", or "-"
+    doc: str
+    stage: str  # "prologue" | "tail"
+    reads: frozenset = frozenset()
+    writes: frozenset = frozenset()
+    inputs: frozenset = frozenset()  # TickInputs planes consumed
+    gives: frozenset = frozenset()  # tick-locals produced
+    takes: frozenset = frozenset()  # tick-locals consumed
+    activity: str = "always"  # human description of the traced gate
+    pred_term: str | None = None  # dispatch-pred symbol that excludes it
+    mask_rank: int = 1
+    span: str = "invariant"  # "live" | "degenerate" | "invariant"
+    cut: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.stage not in ("prologue", "tail"):
+            raise ValueError(f"{self.name}: bad stage {self.stage!r}")
+        if self.span not in ("live", "degenerate", "invariant"):
+            raise ValueError(f"{self.name}: bad span fate {self.span!r}")
+        if self.mask_rank not in (1, 2):
+            raise ValueError(f"{self.name}: bad mask_rank {self.mask_rank!r}")
+        unknown = (self.reads | self.writes) - set(FIELDS)
+        if unknown:
+            raise ValueError(f"{self.name}: unknown state fields {sorted(unknown)}")
+        unknown = self.inputs - set(INPUTS)
+        if unknown:
+            raise ValueError(f"{self.name}: unknown inputs {sorted(unknown)}")
+
+
+def _op(name, phase, doc, stage, *, reads=(), writes=(), inputs=(), gives=(),
+        takes=(), activity="always", pred_term=None, mask_rank=1,
+        span="invariant", cut=None) -> PhaseOp:
+    return PhaseOp(
+        name=name, phase=phase, doc=doc, stage=stage,
+        reads=frozenset(reads), writes=frozenset(writes),
+        inputs=frozenset(inputs), gives=frozenset(gives),
+        takes=frozenset(takes), activity=activity, pred_term=pred_term,
+        mask_rank=mask_rank, span=span, cut=cut,
+    )
+
+
+def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp, ...]:
+    """The tick's op graph for one static build, in execution order.
+
+    Static config flags decide op *presence* (a disabled op is absent from
+    the graph, exactly as its code is compiled out of the build): ``faulty``
+    gates churn and the [N, N] delivery-gate matrix,
+    ``cfg.join_broadcast_enabled`` gates the whole join plane,
+    ``cfg.faithful_failed_broadcast`` gates the intended-semantics Failed
+    delivery, ``telemetry`` gates the counter reductions.
+    """
+    ops: list[PhaseOp] = [
+        _op(
+            "rng_split", "-",
+            "Counter-based PRNG: split(key, 5) -> (proxy, ping, bern, drop, "
+            "next); the carried key is row 4 whatever happens this tick.",
+            "prologue", reads=("key",), writes=("key",), gives=("keys",),
+            span="live",
+        ),
+    ]
+    if faulty:
+        ops.append(_op(
+            "churn", "-",
+            "Silent kill (Q8) + revive-with-reset: aliveness flips, revived "
+            "rows reset to the singleton {self} map.",
+            "prologue",
+            reads=("alive", "S", "T", "lat", "idv", "identity", "never_b", "tick"),
+            writes=("alive", "S", "T", "lat", "idv", "never_b"),
+            inputs=("kill", "revive"), gives=("rv",),
+            activity="any kill/revive scheduled this tick",
+        ))
+    ops.append(_op(
+        "delivery_gate", "-",
+        "ok[s, d]: sender+receiver alive, same partition group, not dropped "
+        "(faulty builds materialize the matrix, the drop draw gated on "
+        "drop_rate > 0; fault-free builds factor it as alive[s] & alive[d] "
+        "so no [N, N] gate exists).",
+        "prologue", reads=("alive",),
+        inputs=("partition", "drop_rate", "drop_ok") if faulty else (),
+        takes=("keys",), gives=("ok",),
+        mask_rank=2 if faulty else 1,
+    ))
+    ops.append(_op(
+        "row_stats", "A",
+        "Phase-A row statistics on the pre-tick snapshot: membership count, "
+        "timed-out-suspect existence, WFIP-timeout existence — one fused "
+        "read of (S, T); also the raw material of the dispatch predicate.",
+        "prologue", reads=("S", "T", "alive", "tick"),
+        gives=("row_count0", "has_timed", "wfip_any", "any_a2"),
+        span="invariant",
+    ))
+    if cfg.join_broadcast_enabled:
+        ops.append(_op(
+            "join_gate", "A1",
+            "maybe_broadcast_join: first call always; afterwards only while "
+            "lonely and rebroadcast-interval old.",
+            "prologue",
+            reads=("alive", "never_b", "last_b", "tick"),
+            writes=("never_b", "last_b"),
+            takes=("row_count0",), gives=("join_b", "any_join"),
+            activity="a Join broadcast fires this tick",
+        ))
+    ops.append(_op(
+        "manual_targets", "A4",
+        "Manual pings (ping_addrs): self/out-of-range targets dropped at "
+        "the transport (D8).",
+        "prologue", reads=("alive",), inputs=("manual_target",),
+        gives=("man_tgt",),
+        activity="a manual ping is scheduled",
+    ))
+
+    # ---- tail: what the fused/full dispatch chooses between ---------------
+    ops.append(_op(
+        "suspicion", "A2",
+        "handle_suspected_peers on the pre-tick snapshot: WFIP timeouts and "
+        "no-proxy timeouts remove, the oldest timed-out WaitingForPing "
+        "escalates to k indirect-ping proxies.",
+        "tail", reads=("S", "T", "alive"), writes=("S", "T", "lat"),
+        takes=("keys", "has_timed", "wfip_any"),
+        gives=("escalate", "insta_remove", "jstar", "proxies", "any_rem"),
+        activity="any_a2: a timed-out suspicion exists", pred_term="any_a2",
+        mask_rank=2, span="invariant",
+    ))
+    ops.append(_op(
+        "probe_draw", "A3",
+        "ping_random_peer: uniform among the 5 longest-unheard Known peers; "
+        "the target cell arms WaitingForPing(now).",
+        "tail", reads=("S", "T", "alive"), writes=("S", "T"),
+        takes=("keys",), gives=("ping_tgt", "has_ping"),
+        span="live", cut="A",
+    ))
+    if cfg.join_broadcast_enabled:
+        ops.append(_op(
+            "join_insert", "B",
+            "Join broadcast delivery: every receiver inserts the joiner as "
+            "Known(now) with the broadcast identity.",
+            "tail", reads=("S", "T", "idv", "identity"),
+            writes=("S", "T", "idv"),
+            takes=("join_b", "any_join", "ok"), gives=("Jm", "is_new_ro"),
+            activity="any_join", pred_term="any_join", mask_rank=2,
+            span="invariant",
+        ))
+    if not cfg.faithful_failed_broadcast:
+        ops.append(_op(
+            "failed_delivery", "B",
+            "Intended-semantics Failed(j) broadcast delivery (Q3 off): "
+            "removal wins against lower-origin Joins — O(N^3) matmuls, "
+            "gated on a removal existing.",
+            "tail", reads=("S", "lat"), writes=("S", "lat"),
+            takes=("ok", "any_rem") + (("Jm",) if cfg.join_broadcast_enabled else ()),
+            activity="any_rem: a removal happened this tick",
+            pred_term="any_a2", mask_rank=2, span="invariant",
+        ))
+    if cfg.join_broadcast_enabled:
+        ops.append(_op(
+            "join_replies", "B",
+            "Join responses (Bernoulli over the sequentially-growing map) "
+            "and the O(N^3) gossip-share union at each joiner — gated on a "
+            "reply actually existing.",
+            "tail", reads=("S", "T"),
+            takes=("keys", "ok", "Jm", "is_new_ro", "row_count0", "any_join"),
+            gives=("reply_del", "gossip", "join_records"),
+            activity="any_join", pred_term="any_join", mask_rank=2,
+            span="invariant",
+        ))
+    ops.append(_op(
+        "call1", "1",
+        "Delivery call 1: direct Pings, manual Pings, PingRequests land; "
+        "Q1 sender-marks apply with exact (fp, count) deltas.",
+        "tail", reads=("S", "T", "lat", "idv", "identity", "alive"),
+        writes=("S", "T", "lat", "idv"),
+        takes=("ping_tgt", "has_ping", "man_tgt", "ok", "proxies",
+               "escalate", "jstar"),
+        gives=("mark1", "ok_ping", "ok_man", "del_ack", "del_ack_man",
+               "del_pr", "del_pping", "fp1", "n1"),
+        span="degenerate", cut="c1",
+    ))
+    ops.append(_op(
+        "call2", "2",
+        "Delivery call 2: direct/manual Acks, proxy Pings, join responses "
+        "land; gossip-learned peers insert back-dated (Q6).",
+        "tail", reads=("S", "T", "lat", "idv", "identity", "alive"),
+        writes=("S", "T", "lat", "idv"),
+        takes=("ping_tgt", "man_tgt", "del_ack", "del_ack_man", "del_pping",
+               "proxies", "escalate", "jstar", "ok")
+        + (("reply_del", "gossip", "any_join") if cfg.join_broadcast_enabled else ()),
+        gives=("fp2", "n2", "dfp2", "dn2"),
+        span="degenerate", cut="c2",
+    ))
+    ops.append(_op(
+        "calls34", "34",
+        "Delivery calls 3+4: the suspect's Acks at proxies, coincidence and "
+        "regular forwarded Acks at suspectors — every datagram descends "
+        "from an escalation this tick.",
+        "tail", reads=("S", "T", "lat", "idv", "identity", "alive"),
+        writes=("S", "T", "lat", "idv"),
+        takes=("escalate", "jstar", "proxies", "del_pr", "del_pping",
+               "del_ack", "del_ack_man", "ping_tgt", "man_tgt", "ok"),
+        gives=("del_pack", "fwd", "fwd_c", "del_fwd", "del_fwd_c"),
+        activity="any escalation this tick", pred_term="any_a2",
+        mask_rank=2, span="invariant", cut="c34",
+    ))
+    ops.append(_op(
+        "anti_entropy", "G",
+        "take_sync_request: arrival-order candidate priority over phases "
+        "0-3, one KnownPeersRequest per peer, request + filtered reply "
+        "resolve within the tick; [N, N] work gated on a request delivering.",
+        "tail",
+        reads=("S", "T", "lat", "idv", "identity", "alive",
+               "kpr_partner", "kpr_fp", "kpr_n"),
+        writes=("S", "T", "lat", "idv", "kpr_partner", "kpr_fp", "kpr_n"),
+        takes=("fp1", "n1", "dfp2", "dn2", "fp2", "n2", "del_ack",
+               "del_ack_man", "ping_tgt", "man_tgt", "ok", "rv")
+        if faulty else
+        ("fp1", "n1", "dfp2", "dn2", "fp2", "n2", "del_ack", "del_ack_man",
+         "ping_tgt", "man_tgt", "ok"),
+        gives=("partner", "del_kpr", "del_rep", "fp_g", "n_g", "fp_f",
+               "n_f", "ae_records"),
+        activity="fingerprints disagree somewhere", span="degenerate",
+        cut="G",
+    ))
+    if telemetry:
+        ops.append(_op(
+            "counters", "-",
+            "ProtocolCounters: pure reductions over masks the tick already "
+            "computed (pings/acks/ping-reqs sent, suspicions, deaths, joins, "
+            "gossip bytes, armed timers) — state trajectory unchanged.",
+            "tail",
+            reads=("S", "alive"),
+            takes=("has_ping", "man_tgt", "ok_ping", "ok_man", "del_pr",
+                   "del_pping", "escalate", "insta_remove", "ae_records",
+                   "join_records", "wfip_any")
+            if cfg.join_broadcast_enabled else
+            ("has_ping", "man_tgt", "ok_ping", "ok_man", "del_pr",
+             "del_pping", "escalate", "insta_remove", "ae_records",
+             "wfip_any"),
+            gives=("counters",),
+            span="degenerate",
+        ))
+    ops.append(_op(
+        "finish", "-",
+        "Metrics + next-state assembly: fingerprint agreement, mean "
+        "membership, the anti-entropy ledger carry, tick+1, the carried key.",
+        "tail",
+        reads=("alive", "identity", "never_b", "last_b", "tick", "key"),
+        writes=("tick", "key"),
+        takes=("fp_f", "n_f", "del_kpr", "partner", "fp_g", "n_g")
+        + (("counters",) if telemetry else ()),
+        gives=("metrics",),
+        span="degenerate",
+    ))
+    return tuple(ops)

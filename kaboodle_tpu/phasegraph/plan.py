@@ -1,0 +1,195 @@
+"""The planner: compose one tick graph into a derived engine's pass program.
+
+``plan(graph, mode)`` groups the graph's ops into the passes one engine
+executes:
+
+- ``full`` — one pass per cond-gated phase, the multi-pass program every
+  engine ran before the phase graph existed. This is the reference shape:
+  rare-phase ops keep their in-graph activity gates, and each gate that
+  carries (S, T) through an identity branch costs a materialized sweep —
+  the pass-count bound PERF.md round-4c measured (~9 sweep-equivalents).
+- ``fused`` — the 2-pass steady-tick program. Ops with ``mask_rank == 2``
+  cannot fold (their write masks need [N, N] intermediates), so the
+  planner *prunes* them and derives the **dispatch predicate** from their
+  ``pred_term`` declarations: the fused program is only taken on ticks
+  where every pruned op is provably inactive. The surviving tail ops fold
+  into a draw pass (the A3 target draw) and ONE update pass whose masks
+  compose into a single elementwise where chain — no cond boundaries, so
+  XLA fuses the whole update into ~3 sweep-equivalents.
+- ``span`` — the warp-leap derivation: inside a quiescent span every op
+  whose span fate is ``invariant`` is pruned (horizon.py's quiescence
+  predicate conjoins exactly these invariance conditions), ``degenerate``
+  ops collapse to the timer-restamp / latency-decay / ledger-fixed-point
+  forms, and the leap batches the k surviving draws as one scan.
+- ``blocked`` — the chunked derivation: the full pass order, each [N, N]
+  pass re-expressed as a ``lax.map`` over row blocks (layout, not logic).
+
+The executable engines assemble themselves FROM these programs (exec.py
+iterates the planned passes; derive.py builds all five engines), so op
+presence, pass grouping, the dispatch predicate, and the telemetry pass
+labels all have one source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kaboodle_tpu.phasegraph.graph import GraphError, TickGraph
+from kaboodle_tpu.phasegraph.ops import PhaseOp
+
+MODES = ("full", "fused", "span", "blocked")
+
+
+@dataclasses.dataclass(frozen=True)
+class Pass:
+    """One executable pass: a named group of ops applied together.
+
+    In the fused program a multi-op pass means the ops' write masks are
+    FOLDED into one composed elementwise chain (legal only when every op
+    has ``mask_rank == 1``); in the full/blocked programs passes execute
+    sequentially with their own activity gates.
+    """
+
+    name: str
+    ops: tuple[PhaseOp, ...]
+
+    @property
+    def op_names(self) -> tuple[str, ...]:
+        return tuple(op.name for op in self.ops)
+
+
+@dataclasses.dataclass(frozen=True)
+class TickProgram:
+    """One derived engine's composed program.
+
+    ``prologue``/``tail`` are the planned pass lists; ``pruned`` maps each
+    statically-or-predicate-excluded op to the reason it is absent;
+    ``pred_terms`` (fused mode) are the activity symbols whose disjunction
+    forms the dispatch predicate — derived from the pruned ops, so adding
+    a new rare-phase op to the graph automatically extends the predicate
+    that keeps the fused program exact.
+    """
+
+    mode: str
+    prologue: tuple[Pass, ...]
+    tail: tuple[Pass, ...]
+    pruned: tuple[tuple[str, str], ...] = ()
+    pred_terms: tuple[str, ...] = ()
+
+    @property
+    def passes(self) -> tuple[Pass, ...]:
+        return self.prologue + self.tail
+
+    def op_names(self) -> tuple[str, ...]:
+        return tuple(op.name for p in self.passes for op in p.ops)
+
+    def pass_of(self, op_name: str) -> str:
+        for p in self.passes:
+            if op_name in p.op_names:
+                return p.name
+        raise KeyError(op_name)
+
+    def describe(self) -> dict:
+        """JSON-able program summary (telemetry trace slices, docs, CLI)."""
+        return {
+            "mode": self.mode,
+            "passes": [
+                {"name": p.name, "stage": "prologue" if p in self.prologue else "tail",
+                 "ops": list(p.op_names)}
+                for p in self.passes
+            ],
+            "pruned": [{"op": name, "reason": why} for name, why in self.pruned],
+            "pred_terms": list(self.pred_terms),
+        }
+
+
+def _single_passes(ops) -> tuple[Pass, ...]:
+    return tuple(Pass(op.name, (op,)) for op in ops)
+
+
+def _plan_full(graph: TickGraph) -> TickProgram:
+    return TickProgram(
+        mode="full",
+        prologue=_single_passes(graph.prologue),
+        tail=_single_passes(graph.tail),
+    )
+
+
+def _plan_fused(graph: TickGraph) -> TickProgram:
+    pruned: list[tuple[str, str]] = []
+    pred: list[str] = []
+    draw: list[PhaseOp] = []
+    update: list[PhaseOp] = []
+    for op in graph.tail:
+        if op.mask_rank == 2:
+            if op.pred_term is None:
+                raise GraphError(
+                    f"{op.name}: mask_rank 2 but no pred_term — the op can "
+                    "neither fold nor be excluded by the dispatch predicate"
+                )
+            pruned.append((op.name, f"excluded by dispatch pred ({op.pred_term})"))
+            if op.pred_term not in pred:
+                pred.append(op.pred_term)
+        elif op.gives & {"ping_tgt", "has_ping"}:
+            draw.append(op)
+        else:
+            update.append(op)
+    if not draw or not update:
+        raise GraphError("fused plan needs a draw op and at least one update op")
+    return TickProgram(
+        mode="fused",
+        prologue=_single_passes(graph.prologue),
+        tail=(Pass("draw", tuple(draw)), Pass("update", tuple(update))),
+        pruned=tuple(pruned),
+        pred_terms=tuple(pred),
+    )
+
+
+def _plan_span(graph: TickGraph) -> TickProgram:
+    if graph.faulty:
+        raise GraphError(
+            "span programs derive from fault-free graphs: a quiescent span "
+            "carries no scheduled events by definition (horizon.py)"
+        )
+    pruned: list[tuple[str, str]] = []
+    live: list[PhaseOp] = []
+    refresh: list[PhaseOp] = []
+    ledger: list[PhaseOp] = []
+    for op in graph.ops:
+        if op.span == "invariant":
+            pruned.append((op.name, "span fixed point (quiescence predicate)"))
+        elif op.span == "live":
+            live.append(op)
+        elif op.name in ("call1", "call2"):
+            refresh.append(op)
+        else:
+            # anti_entropy / counters / finish degenerate to once-per-span
+            # closed forms: the ledger fixed point, leap_counters, tick+k.
+            ledger.append(op)
+    return TickProgram(
+        mode="span",
+        prologue=(),
+        tail=(
+            Pass("draw", tuple(live)),
+            Pass("refresh", tuple(refresh)),
+            Pass("ledger", tuple(ledger)),
+        ),
+        pruned=tuple(pruned),
+    )
+
+
+def _plan_blocked(graph: TickGraph) -> TickProgram:
+    full = _plan_full(graph)
+    return dataclasses.replace(full, mode="blocked")
+
+
+def plan(graph: TickGraph, mode: str) -> TickProgram:
+    """Compose ``graph`` into the given engine mode's program."""
+    if mode not in MODES:
+        raise ValueError(f"unknown plan mode {mode!r} (expected one of {MODES})")
+    return {
+        "full": _plan_full,
+        "fused": _plan_fused,
+        "span": _plan_span,
+        "blocked": _plan_blocked,
+    }[mode](graph)

@@ -1,0 +1,299 @@
+"""The leap kernel: the phase graph's SPAN program — k quiescent ticks as
+one batched pass, bit-exact.
+
+This is the warp engine's derivation from the tick op graph: inside a warp
+span (horizon.py's quiescence predicate + no scheduled events) every op
+the planner marks ``invariant`` is provably a fixed point, and
+``plan(graph, "span")`` prunes them — ``make_leap_fn`` checks at build
+time that the planner's surviving op set is exactly what this module
+implements (draw = rng_split + probe_draw, refresh = the degenerate
+call1/call2 timer-restamp + latency-decay forms, ledger = the
+anti-entropy/finish fixed-point writes), so a new op added to the graph
+cannot silently leak past the leap. Concretely, the dense tick collapses:
+membership, aliveness, identity views, broadcast bookkeeping and the
+anti-entropy ledger are all fixed points, and the only surviving per-tick
+work is
+
+- the A3 ping-target draw (uniform among the 5 longest-unheard Known peers,
+  kaboodle.rs:655-675),
+- the resulting timer refreshes — ``timer[i, tgt_i] = t`` (the A3 stamp,
+  immediately re-stamped by the call-2 Ack) and ``timer[tgt_i, i] = t`` (the
+  call-1 Q1 mark at the target),
+- the latency-EWMA decay on exactly the pinged edges: every sample inside a
+  span is zero ticks (ping and ack resolve within the tick), so the update
+  is ``lat <- 0.2 * lat`` (``0.8 * 0 + 0.2 * old``; first sample 0 where
+  still NaN) at cell ``(i, tgt_i)`` — for mutual pings via the wave-1 mark,
+  otherwise via the wave-2 ack mark, never both (kernel.py ``_fast``'s
+  two-wave sampling order, degenerate inside the span).
+
+Because the PRNG is counter-based, the k ticks' draws do not need the k
+sequential tick dispatches that produce them in the dense kernel: the
+per-tick key chain is k cheap ``split``\\s (O(1) each, no [N, N] work) and
+the k uniform vectors are generated as ONE ``[k, N]`` batch up front.
+
+The remaining sequential dependence — tick s's draw ranks timers that tick
+s-1 refreshed — is paid with O(N·W) work per tick instead of the dense
+kernel's O(N^2): a tick refreshes exactly TWO cells per pinging row, so the
+oldest-k structure is maintained incrementally as *segmented reductions*.
+Each row is segmented into blocks of ``W`` columns and the scan carries, per
+``(row, block)`` segment, its 5 lexicographically-smallest ``(timer, col)``
+pairs. Target selection reduces the ``[N, 5·B]`` summary (the global
+oldest-5 is always among the per-block oldest-5s); the refresh then
+re-reduces only the touched segments — a ``[2N, W]`` gather + masked
+reduction — and scatters them back. Per-tick [N, N] traffic: one O(N)
+scatter. The dense fast-path tick's ~6 combined HBM sweeps (PERF.md round
+4) never happen.
+
+Draw parity: the per-segment and cross-segment reductions compute exactly
+the stable k-smallest ordering of ``ops.sampling._stable_k_smallest_iter``
+(score-then-column lexicographic, ties toward the lower column), and the
+selection tail (``ops.sampling.pick_candidate``) is literally shared with
+the dense kernel — same uniform in, bit-identical target out. The key chain
+replicates the dense tick's ``split(key, 5)`` layout (ping key = row 1,
+next = row 4), so the span exits with the exact key the dense run would
+carry.
+
+Fixed-point writes the leap must still perform once (the dense tick rewrites
+them every tick): ``kpr_partner = -1``, ``kpr_fp = fingerprint``, ``kpr_n =
+membership count`` — the anti-entropy ledger of the span's final tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import membership_fingerprint
+from kaboodle_tpu.ops.sampling import pick_candidate
+from kaboodle_tpu.phasegraph.graph import build_graph
+from kaboodle_tpu.phasegraph.plan import plan
+from kaboodle_tpu.sim.state import MeshState
+from kaboodle_tpu.spec import KNOWN
+
+# The span-program op sets this module implements; make_leap_fn refuses to
+# build if the planner derives anything else from the graph (the leap would
+# no longer be bit-exact with k dense ticks).
+_SPAN_PASSES = {
+    "draw": ("rng_split", "probe_draw"),
+    "refresh": ("call1", "call2"),
+    "ledger": ("anti_entropy", "finish"),
+}
+
+# Segment width: columns per (row, block) summary segment. The per-tick cost
+# is ~O(N·W) for the touched-segment re-reduction plus O(N·5·ceil(N/W)) for
+# the cross-segment selection, so W ~ sqrt-ish of N balances the two; 64
+# keeps both small across the bench range without retuning.
+_SEG_W = 64
+
+
+def _lex_k_smallest(t: jax.Array, c: jax.Array, k: int, tmax) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per row: the k lex-smallest ``(t, c)`` pairs, ties toward lower ``c``.
+
+    The generalized form of ``ops.sampling._stable_k_smallest_iter`` for
+    candidate lists whose column identity is carried explicitly (``c``), so
+    it applies to gathered segments and stacked per-segment summaries alike.
+    ``t == tmax`` is the ineligible sentinel. Returns ``(t_k, c_k, valid)``,
+    each ``[..., k]``, in ascending lex order — identical ordering to the
+    dense kernel's stable k-smallest (pinned in tests/test_warp.py via
+    end-state equality).
+    """
+    big_c = jnp.int32(jnp.iinfo(jnp.int32).max)
+    prev_t = jnp.full(t.shape[:-1], jnp.iinfo(t.dtype).min, t.dtype)
+    prev_c = jnp.full(t.shape[:-1], -1, jnp.int32)
+    out_t, out_c, out_v = [], [], []
+    for _ in range(k):
+        after = (t > prev_t[..., None]) | (
+            (t == prev_t[..., None]) & (c > prev_c[..., None])
+        )
+        t_r = jnp.min(jnp.where(after, t, tmax), axis=-1)
+        c_r = jnp.min(
+            jnp.where(after & (t == t_r[..., None]), c, big_c), axis=-1
+        )
+        out_t.append(t_r)
+        out_c.append(c_r)
+        out_v.append(t_r != tmax)
+        prev_t, prev_c = t_r, c_r
+    return (
+        jnp.stack(out_t, axis=-1),
+        jnp.stack(out_c, axis=-1),
+        jnp.stack(out_v, axis=-1),
+    )
+
+
+def make_leap_fn(
+    cfg: SwimConfig,
+    k: int,
+    constrain: Callable[[jax.Array], jax.Array] | None = None,
+) -> Callable[[MeshState], MeshState]:
+    """Build the jittable k-tick leap for a given protocol config.
+
+    ``k`` is static (the span length folds into the compiled program — the
+    warp runner caches one program per distinct span length). ``constrain``
+    is the sharding hook: applied to every scan carry each step, it keeps
+    the GSPMD layout stable under the scan, like
+    ``parallel.make_sharded_tick``'s per-tick constraint (the runner passes
+    a row-axis pin built from ``parallel.row_matrix_sharding``).
+
+    Precondition (checked by the caller via horizon.make_quiescence_fn,
+    never re-checked here): the state is quiescent and the next ``k`` ticks
+    carry no scheduled events. Under that precondition the result is
+    bit-equal to ``k`` dense fault-free ticks (tests/test_warp.py pins it
+    per state variant; tests/test_fuzz_parity.py fuzzes it through whole
+    schedules).
+    """
+    if k < 1:
+        raise ValueError("need k >= 1")
+    # Derive the span program from the op graph and pin it to what this
+    # module implements: every op outside these passes must have been
+    # pruned by the planner as a span fixed point.
+    prog = plan(build_graph(cfg, faulty=False), "span")
+    got = {p.name: p.op_names for p in prog.tail}
+    if got != _SPAN_PASSES:
+        raise NotImplementedError(
+            f"span plan {got} != leap implementation {_SPAN_PASSES}"
+        )
+    det = cfg.deterministic
+    kk = cfg.num_candidate_target_peers
+
+    def pin(x: jax.Array) -> jax.Array:
+        return constrain(x) if constrain is not None else x
+
+    # Named scope: labels the leap's ops in jax.profiler captures (metadata
+    # only — numerics and compiled-program identity are unchanged).
+    @jax.named_scope("kaboodle:leap")
+    def leap(st: MeshState) -> MeshState:  # graftlint: traced
+        n = st.state.shape[-1]
+        n_cand = min(kk, n)
+        W = min(_SEG_W, n)
+        B = -(-n // W)  # segments per row
+        pad = B * W - n
+        idx = jnp.arange(n, dtype=jnp.int32)
+        eye = idx[:, None] == idx[None, :]
+        S, T, alive, lat = st.state, st.timer, st.alive, st.latency
+        has_lat = lat is not None
+        tmax = jnp.asarray(jnp.iinfo(T.dtype).max, dtype=T.dtype)
+        tmin = jnp.asarray(jnp.iinfo(T.dtype).min, dtype=T.dtype)
+
+        # The eligibility mask is a span invariant (membership and aliveness
+        # are fixed points), so the masked scores — exactly what the dense
+        # draw ranks — can be the carry; every in-span write lands on an
+        # eligible cell (both endpoints alive and mutually Known). Padded to
+        # the segment grid with ineligible sentinel columns.
+        elig = alive[:, None] & (S == KNOWN) & ~eye
+        scores0 = jnp.pad(
+            jnp.where(elig, T, tmax), ((0, 0), (0, pad)), constant_values=tmax
+        )
+        cols = jnp.broadcast_to(jnp.arange(B * W, dtype=jnp.int32)[None, :], (n, B * W))
+
+        # Entry summaries: per (row, segment), the oldest-5 (timer, col)
+        # pairs — one blocked reduction over the padded matrix, the only
+        # O(N^2) pass besides the final merge-back.
+        sum_t0, sum_c0, _ = _lex_k_smallest(
+            scores0.reshape(n, B, W), cols.reshape(n, B, W), n_cand, tmax
+        )  # [n, B, n_cand]
+
+        # ---- the [k, ...] draw batch (counter-based PRNG) -----------------
+        # Key chain: the dense tick derives (proxy, ping, bern, drop, next)
+        # from split(key, 5) and carries row 4; only the ping key is ever
+        # consumed on a quiescent tick.
+        def key_step(key, _):
+            ks = jax.random.split(key, 5)
+            return ks[4], ks[1]
+
+        key_final, ping_keys = jax.lax.scan(key_step, st.key, None, length=k)
+        ticks = st.tick + jnp.arange(k, dtype=jnp.int32)  # [k] in-span tick values
+        if det:
+            xs = (ticks, jnp.zeros((k, 1), dtype=jnp.float32))  # u unused
+        else:
+            # dtype pinned f32 (KB401): must match the dense kernel's
+            # pick_candidate uniforms bit-for-bit under any x64 flag state.
+            xs = (
+                ticks,
+                jax.vmap(
+                    lambda kp: jax.random.uniform(kp, (n,), dtype=jnp.float32)
+                )(ping_keys),
+            )
+
+        seg = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W] within-segment
+
+        def body(carry, x):
+            scores, sum_t, sum_c, lat = carry
+            t, u_t = x
+            tT = t.astype(scores.dtype)
+
+            # Cross-segment selection: the global oldest-5 of a row is among
+            # its per-segment oldest-5s, in the same stable (timer, col)
+            # order the dense draw ranks.
+            _, cand_idx, cand_valid = _lex_k_smallest(
+                sum_t.reshape(n, B * n_cand),
+                sum_c.reshape(n, B * n_cand),
+                n_cand,
+                tmax,
+            )
+            # The shared selection tail derives the candidate count from
+            # the valid mask itself (dead/empty rows get 0 -> tgt = -1).
+            u_sel = None if det else u_t
+            tgt = pick_candidate(
+                jnp.minimum(cand_idx, n - 1), cand_valid, u_sel
+            )
+            has_ping = tgt >= 0  # False exactly on dead/empty rows
+            tgtc = jnp.clip(tgt, 0)
+
+            # Cumulative timer effect of the tick's surviving traffic: the
+            # A3 stamp + ack re-stamp at (i, tgt_i) and the Q1 mark at
+            # (tgt_i, i), all writing the same tick value — a scatter-max
+            # with a dtype-min no-op sentinel masks out pingless rows, and
+            # duplicate edges (mutual pings) collide on equal values.
+            rows_u = jnp.concatenate([idx, tgtc])
+            cols_u = jnp.concatenate([tgtc, idx])
+            val = jnp.where(jnp.concatenate([has_ping, has_ping]), tT, tmin)
+            scores = pin(scores.at[rows_u, cols_u].max(val))
+
+            # Touched segments — (i, seg(tgt_i)) and (tgt_i, seg(i)) — are
+            # re-reduced from the updated scores and scattered back; every
+            # other segment's summary is untouched by construction.
+            blocks_u = cols_u // W
+            seg_cols = blocks_u[:, None] * W + seg  # [2N, W] global cols
+            seg_t = scores[rows_u[:, None], seg_cols]
+            new_t, new_c, _ = _lex_k_smallest(seg_t, seg_cols, n_cand, tmax)
+            sum_t = pin(sum_t.at[rows_u, blocks_u].set(new_t))
+            sum_c = pin(sum_c.at[rows_u, blocks_u].set(new_c))
+
+            if has_lat:
+                # One zero-tick EWMA sample per pinged edge (module
+                # docstring): NaN -> 0.0 first sample, else 0.2 * old.
+                cur = lat[idx, tgtc]
+                upd = jnp.where(
+                    jnp.isnan(cur), jnp.float32(0.0), jnp.float32(0.2) * cur
+                )
+                lat = pin(lat.at[idx, tgtc].set(jnp.where(has_ping, upd, cur)))
+            return (scores, sum_t, sum_c, lat), None
+
+        carry0 = (pin(scores0), pin(sum_t0), pin(sum_c0), lat)
+        (scores_k, _, _, lat_k), _ = jax.lax.scan(body, carry0, xs)
+
+        # Anti-entropy ledger at the span's final tick (fixed point, written
+        # once): no request in flight, fingerprint + map size per row.
+        fp = membership_fingerprint(
+            S > 0, st.id_view if st.id_view is not None else st.identity
+        )
+        n_row = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
+
+        return dataclasses.replace(
+            st,
+            timer=jnp.where(elig, scores_k[:, :n], T),
+            latency=lat_k,
+            tick=st.tick + k,
+            key=key_final,
+            kpr_partner=jnp.full((n,), -1, dtype=jnp.int32),
+            kpr_fp=fp,
+            kpr_n=n_row,
+        )
+
+    # Program metadata for derived consumers (trace slices, registry, dryrun).
+    leap.program = prog
+    return leap

@@ -22,6 +22,33 @@ import numpy as np
 from kaboodle_tpu.sim.state import TickMetrics
 
 
+def leaf_equal(a, b) -> bool:
+    """Bit-equality of two pytree leaves, NaN==NaN on the latency plane.
+
+    THE bit-exactness predicate of every A/B and dryrun gate (`bench.py`
+    --warp / --telemetry-ab / --fastpath-ab, `python -m kaboodle_tpu
+    phasegraph`): shape and dtype must match exactly, values must match
+    bitwise, and on floating leaves NaN positions must coincide (the
+    latency EWMA plane carries NaN for never-measured edges). One
+    definition, one home — a lane-local copy that drifts redefines what
+    "bit-exact" means for that gate only.
+    """
+    av, bv = np.asarray(a), np.asarray(b)
+    if av.shape != bv.shape or av.dtype != bv.dtype:
+        return False
+    if np.issubdtype(av.dtype, np.floating):
+        return bool(((av == bv) | (np.isnan(av) & np.isnan(bv))).all())
+    return bool((av == bv).all())
+
+
+def state_equal(a, b) -> bool:
+    """:func:`leaf_equal` over two whole pytrees (states, metrics)."""
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(leaf_equal(x, y) for x, y in zip(la, lb))
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture a JAX profiler trace of everything run inside the block.

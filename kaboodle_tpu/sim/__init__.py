@@ -1,10 +1,6 @@
 """Vectorized lockstep SWIM simulator: the TPU tick kernel and its runners."""
 
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics, init_state, idle_inputs
-from kaboodle_tpu.sim.kernel import make_tick_fn
-from kaboodle_tpu.sim.chunked import make_chunked_tick_fn
-from kaboodle_tpu.sim.runner import simulate, run_until_converged
-from kaboodle_tpu.sim.scenario import Scenario, baseline_scenario
 
 __all__ = [
     "MeshState",
@@ -19,3 +15,27 @@ __all__ = [
     "Scenario",
     "baseline_scenario",
 ]
+
+# Lazy (PEP 562, same idiom as the package root): the kernel names are
+# shims over kaboodle_tpu.phasegraph, and phasegraph's engine modules
+# import sim.state — which triggers THIS __init__. Resolving the shim
+# names on first attribute access (instead of at package-init time) lets
+# either side be imported first without a half-initialized-module cycle.
+_LAZY = {
+    "make_tick_fn": "kaboodle_tpu.sim.kernel",
+    "make_chunked_tick_fn": "kaboodle_tpu.sim.chunked",
+    "simulate": "kaboodle_tpu.sim.runner",
+    "run_until_converged": "kaboodle_tpu.sim.runner",
+    "Scenario": "kaboodle_tpu.sim.scenario",
+    "baseline_scenario": "kaboodle_tpu.sim.scenario",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        value = getattr(importlib.import_module(_LAZY[name]), name)
+        globals()[name] = value  # cache: __getattr__ runs once per name
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

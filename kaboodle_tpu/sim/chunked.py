@@ -1,1101 +1,13 @@
-"""Row-blocked tick kernel: the transient-bounded twin of ``make_tick_fn``.
+"""Row-blocked tick kernel — a shim over the phase-graph derivation.
 
-The flagship kernel (kernel.py) expresses every phase as whole-``[N, N]``
-tensor ops. That is the right shape for XLA:TPU, which fuses the passes into
-a few HBM sweeps — but XLA:CPU materializes far more of the intermediates,
-and at N = 65,536 a single tick's live temporaries exceed even a 125 GiB
-host (SCALE_PROOF.md attempts 1-6 and 8: every full-tick attempt at that N
-was OOM-killed; the join-gossip union's O(N^3) matmul operands are the worst
-case but even kill-only ticks die on the int32 [N, N] temporaries).
-
-This module re-expresses the SAME tick (kaboodle.rs:746-786; the lockstep
-round structure in kernel.py's docstring) as a sequence of passes, each a
-``lax.map`` over row blocks of size ``block``:
-
-- every [N, N] read/write touches one ``[block, N]`` slice at a time, so
-  peak transients are O(block·N) instead of O(N^2)·live-temps;
-- the O(N^3) join-gossip union and the intended-semantics Failed-broadcast
-  delivery become two-level blocked contractions (receiver-axis blocks,
-  inner reduction over sender blocks) — peak operand footprint O(block·N)
-  plus the two join-tick residents noted below;
-- cross-row phases (the anti-entropy share gather, the union) read the
-  pass-input snapshot, which is exactly the two-pass delivery serialization
-  the lockstep oracle defines (``lax.map`` bodies are functional: every
-  block of one pass reads the same input state).
-
-Parity contract (tests/test_chunked.py):
-
-- **Bit-exact** with ``make_tick_fn`` whenever the tick consumes only
-  per-row draws — all of deterministic mode, and random mode on ticks with
-  no escalation, no join reply, and no random drop (the ping-target draw is
-  per-row and reproduces exactly).
-- **D10 (documented deviation):** the matrix-shaped draws (escalation proxy
-  gumbel, join-reply Bernoulli, random drop) are generated per block from
-  ``fold_in(key, block_index)`` — same counter-based PRNG family, different
-  stream layout, so random-mode trajectories through those branches are
-  distributionally (not samplewise) equivalent, the same caveat as D6.
-  Tests needing exact faulty parity pass an explicit ``drop_ok``.
-
-Memory (N = 65,536, lean+int16, quiet faulty tick): 12 GiB resident state
-+ 12 GiB pass outputs + O(block·N) temporaries ≈ 24-26 GiB — vs the >125 GiB
-the whole-tensor kernel demands on XLA:CPU. Join-bearing ticks add two
-[N, N] bool residents (``reply_del``, ``gossip``) ≈ +8 GiB, the documented
-2-3x boot-tick budget (MEMORY_PLAN.md). Intended-semantics Failed delivery
-(non-default) adds the ``rem``/``fail_del`` residents on removal ticks.
-
-Single-address-space by design: the blocked passes slice the row axis
-dynamically, which would fight GSPMD's static row sharding — use the
-whole-tensor kernel for sharded meshes (it is the right program there) and
-this one where a single device/host must bound its transients: the
-emulating-host scale proofs, and single-chip TPU runs near the HBM ceiling.
+The row-blocked implementation that lived here moved to
+:mod:`kaboodle_tpu.phasegraph.blocked`, where it executes the op graph's
+``blocked`` program (same ops and order as the dense engine, O(block·N)
+transients — see ``kaboodle_tpu/phasegraph/__init__.py``). This module
+keeps the historical import path for every call site, scale-proof script,
+and test.
 """
 
-from __future__ import annotations
+from kaboodle_tpu.phasegraph.blocked import make_chunked_tick_fn
 
-from typing import Callable
-
-import jax
-import jax.numpy as jnp
-
-from kaboodle_tpu.config import SwimConfig
-from kaboodle_tpu.ops.hashing import fingerprint_agreement, peer_record_hash
-from kaboodle_tpu.ops.sampling import (
-    _stable_k_smallest_iter,
-    bernoulli_matrix,
-    broadcast_reply_prob,
-    choose_among_candidates,
-    choose_k_members,
-)
-from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
-from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
-from kaboodle_tpu.telemetry.counters import (
-    RECORD_BYTES,
-    ProtocolCounters,
-    TickTelemetry,
-)
-
-_I32MAX = jnp.iinfo(jnp.int32).max
-
-
-def _slice_rows(a: jax.Array, s0: jax.Array, block: int) -> jax.Array:
-    return jax.lax.dynamic_slice_in_dim(a, s0, block, axis=0)
-
-
-def _slice_cols(a: jax.Array, s0: jax.Array, block: int) -> jax.Array:
-    return jax.lax.dynamic_slice_in_dim(a, s0, block, axis=1)
-
-
-def _int8_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """int32 accumulation of a boolean AND-OR contraction (MXU-friendly)."""
-    return jax.lax.dot_general(
-        a.astype(jnp.int8), b.astype(jnp.int8),
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
-    )
-
-
-def make_chunked_tick_fn(
-    cfg: SwimConfig,
-    faulty: bool = True,
-    block: int = 1024,
-    drop: bool = True,
-    boot_union: bool = False,
-    telemetry: bool = False,
-) -> Callable[[MeshState, TickInputs], tuple[MeshState, TickMetrics]]:
-    """Build the row-blocked tick for a given config (see module docstring).
-
-    ``block`` must divide N (checked at trace time). ``drop=False`` compiles
-    out the random-drop resident entirely — callers that guarantee
-    ``drop_rate == 0`` (the at-scale proofs) use it to avoid materializing
-    an [N, N] gate matrix; an explicit ``inp.drop_ok`` still applies.
-    With ``drop=True`` the per-block uniform draws are gated on
-    ``drop_rate > 0`` in-graph (zero-rate ticks skip the RNG sweep), but the
-    [N, N] bool gate resident itself is part of the compiled program
-    (~4 GiB at N=65,536) — the advertised O(block·N) transient bound
-    requires ``drop=False``.
-    The Pallas stage kernels and the fast/slow split do not apply here
-    (this path is its own memory-bound formulation); every other config
-    flag behaves exactly as in ``make_tick_fn``.
-
-    ``telemetry=True`` is the telemetry-plane build (the chunked half of the
-    ``make_tick_fn`` contract): returns ``(state, TickTelemetry)`` with the
-    same :class:`ProtocolCounters` definitions, every added reduction either
-    O(block·N)-blocked or gated on the phase that feeds it, counters
-    bit-exact with the dense telemetry build wherever state parity holds.
-
-    ``boot_union=True`` replaces the O(N^3) join-gossip contraction with
-    its closed form for the fresh broadcast-boot avalanche. PRECONDITION
-    (caller-owned, tested, NOT checked in-graph beyond the build-time
-    ``faulty`` guard below): a FAULT-FREE tick (everyone alive, no
-    drop/partition input — ``faulty=True`` is therefore never valid with it
-    and raises at build time) whose start-of-round membership maps are
-    exactly the singletons {self} — i.e. tick 0 of a broadcast boot from
-    ``init_state(ring_contacts=0)``. There,
-    ``member_a == eye`` collapses the share term to ``reply_del.T`` and
-    the joiner-prefix term to a reply-count comparison:
-
-        gossip[o, j] = reply_del[j, o]
-                     | (join_b[j] & (cnt[o] - reply_del[j, o] > 0) & (j <= o))
-
-    with ``cnt[o] = sum_r reply_del[r, o]`` — pure elementwise over the
-    reply transpose, no contraction. Bit-exact with the dense union on
-    that tick (tests/test_chunked.py pins it); on any other tick shape the
-    result is undefined. This is the union form the PERF.md north-star
-    projection budgets for the < 2 s avalanche on a v5e-8.
-    """
-
-    det = cfg.deterministic
-    if boot_union and faulty:
-        # The closed form assumes every Join delivers everywhere (no drop /
-        # partition / dead peers); a faulty build can never satisfy that, so
-        # this combination is silently-wrong-by-construction (ADVICE r5).
-        raise ValueError(
-            "boot_union=True requires faulty=False: the closed-form join "
-            "union assumes fault-free delivery on the boot tick"
-        )
-
-    # Traced from other modules (jit call sites in the scale-proof scripts
-    # and tests) — same pragma rationale as kernel.py's tick.
-    def tick(st: MeshState, inp: TickInputs) -> tuple[MeshState, TickMetrics]:  # graftlint: traced
-        n = st.state.shape[-1]
-        if n % block != 0:
-            raise ValueError(f"block {block} does not divide N={n}")
-        nb = n // block
-        starts = jnp.arange(nb, dtype=jnp.int32) * block
-
-        t = st.tick
-        idx = jnp.arange(n, dtype=jnp.int32)
-        key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
-
-        S, T = st.state, st.timer
-        tT = t.astype(T.dtype)
-        TMAX = int(jnp.iinfo(T.dtype).max)
-        lat, idv = st.latency, st.id_view
-        has_lat = lat is not None
-        has_idv = idv is not None
-        alive, never_b, last_b = st.alive, st.never_broadcast, st.last_broadcast
-        id_row = st.identity[None, :]
-        rec_hash = peer_record_hash(idx.astype(jnp.uint32), st.identity)
-        INF = jnp.int32(_I32MAX)
-        gossip_backdate = (
-            cfg.max_peer_share_age_ticks if cfg.backdate_gossip_inserts else 0
-        )
-
-        def blk_idx(s0):
-            """Global row indices of the block starting at s0: int32 [B]."""
-            return s0 + jnp.arange(block, dtype=jnp.int32)
-
-        def blk_eye(s0):
-            return blk_idx(s0)[:, None] == idx[None, :]
-
-        def pmap_blocks(body):
-            """lax.map of ``body(s0)`` over the row blocks; leaves reshaped
-            from [nb, B, ...] back to [N, ...]."""
-            out = jax.lax.map(body, starts)
-            return jax.tree.map(lambda a: a.reshape((n,) + a.shape[2:]), out)
-
-        def state_rows(SS, TT, ll, vv, s0):
-            return (
-                _slice_rows(SS, s0, block),
-                _slice_rows(TT, s0, block),
-                _slice_rows(ll, s0, block) if has_lat else None,
-                _slice_rows(vv, s0, block) if has_idv else None,
-            )
-
-        # ---- churn (vector part + gated row resets; kernel.py Q8) ----------
-        if faulty:
-            alive = (alive & ~inp.kill) | inp.revive
-            rv = inp.revive
-
-            def _churn_rows(s0):
-                Sb, Tb, lb, vb = state_rows(S, T, lat, idv, s0)
-                eye_b = blk_eye(s0)
-                rv_b = rv[blk_idx(s0)][:, None]
-                Sb = jnp.where(rv_b, jnp.where(eye_b, jnp.int8(KNOWN), jnp.int8(0)), Sb)
-                Tb = jnp.where(rv_b, jnp.where(eye_b, tT, jnp.zeros((), T.dtype)), Tb)
-                out = [Sb, Tb]
-                if has_lat:
-                    out.append(jnp.where(rv_b, jnp.nan, lb))
-                if has_idv:
-                    out.append(jnp.where(rv_b, jnp.where(eye_b, id_row, jnp.uint32(0)), vb))
-                return tuple(out)
-
-            def _apply_churn(args):
-                return pmap_blocks(_churn_rows)
-
-            def _no_churn(args):
-                return args
-
-            churned = jax.lax.cond(
-                jnp.any(rv), _apply_churn, _no_churn,
-                tuple(x for x in (S, T, lat, idv) if x is not None),
-            )
-            it = iter(churned)
-            S, T = next(it), next(it)
-            lat = next(it) if has_lat else None
-            idv = next(it) if has_idv else None
-            never_b = never_b | rv
-        else:
-            rv = jnp.zeros((n,), dtype=bool)
-
-        # ---- delivery gate -------------------------------------------------
-        # Vector factors (aliveness, partition) stay vectors; the random-drop
-        # factor becomes ONE bool resident built per block (D10 streams) so
-        # edge gathers and block slices read the same tick-consistent gates.
-        if faulty:
-            part = inp.partition
-
-            def _vec_ok(s, d):
-                return (alive[jnp.clip(s, 0)] & alive[jnp.clip(d, 0)]
-                        & (part[jnp.clip(s, 0)] == part[jnp.clip(d, 0)]))
-
-            if inp.drop_ok is not None:
-                drop_mat = inp.drop_ok
-            elif drop:
-                def _drop_rows(s0):
-                    bi = s0 // block
-                    u = jax.random.uniform(
-                        jax.random.fold_in(key_drop, bi), (block, n),
-                        dtype=jnp.float32)
-                    return u >= inp.drop_rate
-
-                # Gate the per-block uniform draws on the (traced) rate, as
-                # kernel.py does: a drop=True caller running a zero-rate tick
-                # (churn/partition-only schedules) skips the RNG sweep and its
-                # float temporaries entirely. The [N, N] bool resident itself
-                # is a property of the drop=True build (the cond's all-True
-                # branch still produces it) — callers that need the module's
-                # advertised O(block*N) bound must pass drop=False.
-                drop_mat = jax.lax.cond(
-                    inp.drop_rate > 0,
-                    lambda: pmap_blocks(_drop_rows),
-                    lambda: jnp.ones((n, n), dtype=bool),
-                )
-            else:
-                drop_mat = None
-
-            def ok_edge(s, d):
-                e = _vec_ok(s, d)
-                if drop_mat is not None:
-                    e &= drop_mat[jnp.clip(s, 0), jnp.clip(d, 0)]
-                return e
-
-            def ok_rows(s0):
-                """ok[s, d] for the s-block: delivery FROM these rows."""
-                o = (alive[blk_idx(s0)][:, None] & alive[None, :]
-                     & (part[blk_idx(s0)][:, None] == part[None, :]))
-                if drop_mat is not None:
-                    o &= _slice_rows(drop_mat, s0, block)
-                return o
-
-            def okT_rows(s0):
-                """ok[s, r] transposed to [B(r), N(s)]: delivery INTO the block."""
-                o = (alive[None, :] & alive[blk_idx(s0)][:, None]
-                     & (part[None, :] == part[blk_idx(s0)][:, None]))
-                if drop_mat is not None:
-                    o &= _slice_cols(drop_mat, s0, block).T
-                return o
-        else:
-
-            def ok_edge(s, d):
-                return alive[jnp.clip(s, 0)] & alive[jnp.clip(d, 0)]
-
-            def ok_rows(s0):
-                return alive[blk_idx(s0)][:, None] & alive[None, :]
-
-            def okT_rows(s0):
-                return alive[None, :] & alive[blk_idx(s0)][:, None]
-
-        # ---- phase-A row stats on the post-churn snapshot ------------------
-        # (kernel.py "Phase-A row stats"; same formulas, blocked.)
-        S0, T0 = S, T
-
-        def _stats_rows(s0):
-            Sb = _slice_rows(S0, s0, block)
-            Tb = _slice_rows(T0, s0, block)
-            al_b = alive[blk_idx(s0)][:, None]
-            age_b = t - Tb
-            eye_b = blk_eye(s0)
-            row_count = jnp.sum(Sb > 0, axis=-1, dtype=jnp.int32)
-            timed_wfp = al_b & (Sb == WAITING_FOR_PING) & (
-                age_b >= cfg.ping_timeout_ticks
-            )
-            has_timed = jnp.any(timed_wfp, axis=-1)
-            wfip_any = jnp.any(
-                al_b & (Sb == WAITING_FOR_INDIRECT_PING)
-                & (age_b >= cfg.ping_timeout_ticks),
-                axis=-1,
-            )
-            # D1 escalation pick: oldest timed-out WaitingForPing, ties to
-            # the lower index (kernel.py _rest's jstar).
-            tsel = jnp.where(timed_wfp, Tb, TMAX)
-            min_t = jnp.min(tsel, axis=-1)
-            jstar_mask = timed_wfp & (Tb == min_t[:, None])
-            jstar = jnp.min(jnp.where(jstar_mask, idx[None, :], _I32MAX), axis=-1)
-            jstar = jnp.where(has_timed, jstar, -1).astype(jnp.int32)
-            has_cand = jnp.any((Sb == KNOWN) & ~eye_b, axis=-1)
-            return row_count, has_timed, wfip_any, jstar, has_cand
-
-        row_count0, has_timed, wfip_any, jstar, has_cand = pmap_blocks(_stats_rows)
-        any_a2 = jnp.any(wfip_any) | jnp.any(has_timed)
-        escalate = has_timed & has_cand
-        insta_remove = has_timed & ~has_cand
-        any_esc = jnp.any(escalate)
-        any_rem = jnp.any(wfip_any) | jnp.any(insta_remove)
-
-        # ---- A1 join broadcast throttle (vectors; kaboodle.rs:228-251) -----
-        if cfg.join_broadcast_enabled:
-            lonely = row_count0 <= 1
-            join_b = alive & (
-                never_b | (lonely & ((t - last_b) >= cfg.rebroadcast_interval_ticks))
-            )
-            last_b = jnp.where(join_b, t, last_b)
-            never_b = never_b & ~join_b
-            any_join = jnp.any(join_b)
-        else:
-            join_b = jnp.zeros((n,), dtype=bool)
-            any_join = jnp.bool_(False)
-
-        man_tgt = jnp.where(
-            alive & (inp.manual_target != idx) & (inp.manual_target < n),
-            inp.manual_target,
-            -1,
-        )
-
-        # ---- proxies (escalation-gated; D10 streams in random mode) --------
-        kprox = min(cfg.num_indirect_ping_peers, n)
-
-        def _proxy_rows(s0):
-            Sb = _slice_rows(S0, s0, block)
-            known_cand = (Sb == KNOWN) & ~blk_eye(s0)
-            return choose_k_members(
-                known_cand, cfg.num_indirect_ping_peers,
-                jax.random.fold_in(key_proxy, s0 // block), det,
-            )
-
-        proxies, proxies_valid = jax.lax.cond(
-            any_esc,
-            lambda: pmap_blocks(_proxy_rows),
-            lambda: (jnp.zeros((n, kprox), jnp.int32), jnp.zeros((n, kprox), bool)),
-        )
-        proxies_valid &= escalate[:, None]
-
-        # ---- A2 apply (gated write pass; kaboodle.rs:558-653) --------------
-        jstar_is = lambda s0: idx[None, :] == jstar[blk_idx(s0)][:, None]  # noqa: E731
-
-        def _a2_rows(s0):
-            Sb, Tb, lb, _ = state_rows(S, T, lat, idv, s0)
-            al_b = alive[blk_idx(s0)][:, None]
-            age_b = t - Tb
-            rem = al_b & (Sb == WAITING_FOR_INDIRECT_PING) & (
-                age_b >= cfg.ping_timeout_ticks
-            )
-            jcell = jstar_is(s0)
-            rem = rem | (insta_remove[blk_idx(s0)][:, None] & jcell)
-            Sb2 = jnp.where(rem, jnp.int8(0), Sb)
-            esc_cell = escalate[blk_idx(s0)][:, None] & jcell
-            Sb2 = jnp.where(esc_cell, jnp.int8(WAITING_FOR_INDIRECT_PING), Sb2)
-            Tb2 = jnp.where(esc_cell, tT, Tb)
-            if has_lat:
-                return Sb2, Tb2, jnp.where(rem, jnp.nan, lb)
-            return Sb2, Tb2
-
-        def _apply_a2(args):
-            return pmap_blocks(_a2_rows)
-
-        a2d = jax.lax.cond(
-            any_a2, _apply_a2, lambda a: a,
-            tuple(x for x in (S, T, lat) if x is not None),
-        )
-        it = iter(a2d)
-        S, T = next(it), next(it)
-        lat = next(it) if has_lat else None
-
-        # ---- intended-semantics Failed delivery (non-default; Q3 off) ------
-        # fail_del[r, j] from the same formulas as kernel.py _fail_del, as a
-        # blocked contraction over the remover axis. ``rem`` is recomputed
-        # per sender block from the PRE-A2 snapshot (S0/T0 still alias it).
-        fail_del = None
-        if not cfg.faithful_failed_broadcast:
-
-            def _rem_block(i0):
-                Sb = _slice_rows(S0, i0, block)
-                Tb = _slice_rows(T0, i0, block)
-                al_b = alive[blk_idx(i0)][:, None]
-                age_b = t - Tb
-                r = al_b & (Sb == WAITING_FOR_INDIRECT_PING) & (
-                    age_b >= cfg.ping_timeout_ticks
-                )
-                return r | (insta_remove[blk_idx(i0)][:, None] & jstar_is(i0))
-
-            def _fail_rows(s0):
-                # [B, N] fail-delivery block for receiver rows s0..s0+B.
-                okT_b = okT_rows(s0)  # [B(r), N(i)] origin i delivers to r
-
-                def _accum(carry, i0):
-                    gt, anyv = carry
-                    rem_b = _rem_block(i0)  # [Bi, N(j)]
-                    rem_gt = rem_b & (blk_idx(i0)[:, None] > idx[None, :])
-                    okT_slice = jax.lax.dynamic_slice_in_dim(
-                        okT_b, i0, block, axis=1)
-                    gt = gt + _int8_matmul(okT_slice, rem_gt)
-                    anyv = anyv + _int8_matmul(okT_slice, rem_b)
-                    return (gt, anyv), None
-
-                z = jnp.zeros((block, n), jnp.int32)
-                (gt, anyv), _ = jax.lax.scan(_accum, (z, z), starts)
-                Jm_b = (join_b[None, :] & okT_b & ~blk_eye(s0)
-                        if cfg.join_broadcast_enabled
-                        else jnp.zeros((block, n), bool))
-                return ~blk_eye(s0) & jnp.where(Jm_b, gt > 0, anyv > 0)
-
-            fail_del = jax.lax.cond(
-                any_rem,
-                lambda: pmap_blocks(_fail_rows),
-                lambda: jnp.zeros((n, n), dtype=bool),
-            )
-
-        # ---- A3 ping-target candidates (read pass) + the per-row draw ------
-        def _a3_rows(s0):
-            Sb = _slice_rows(S, s0, block)
-            Tb = _slice_rows(T, s0, block)
-            elig = alive[blk_idx(s0)][:, None] & (Sb == KNOWN) & ~blk_eye(s0)
-            tmax = jnp.asarray(jnp.iinfo(Tb.dtype).max, dtype=Tb.dtype)
-            scores = jnp.where(elig, Tb, tmax)
-            kk = 1 if det else min(cfg.num_candidate_target_peers, n)
-            return _stable_k_smallest_iter(scores, kk, tmax)
-
-        cand_idx, cand_valid = pmap_blocks(_a3_rows)
-        # The per-row uniform pick over the concatenated candidate lists is
-        # samplewise identical to the unchunked kernel (same key, same rows).
-        ping_tgt = choose_among_candidates(cand_idx, cand_valid, key_ping, det)
-        has_ping = ping_tgt >= 0
-
-        # ---- delivery vectors (kernel.py calls 1-4 plumbing) ---------------
-        ok_ping = has_ping & ok_edge(idx, ping_tgt)
-        ok_man = (man_tgt >= 0) & ok_edge(idx, man_tgt)
-        del_pr = proxies_valid & ok_edge(idx[:, None], proxies)
-        del_ack = ok_ping & ok_edge(ping_tgt, idx)
-        del_ack_man = ok_man & ok_edge(man_tgt, idx)
-        ok_p2x = ok_edge(proxies, jstar[:, None])
-        del_pping = del_pr & ok_p2x
-        del_pack = del_pping & ok_edge(jstar[:, None], proxies)
-        p_tgt = ping_tgt[jnp.clip(proxies, 0)]
-        p_man = man_tgt[jnp.clip(proxies, 0)]
-        p_got_direct = del_ack[jnp.clip(proxies, 0)]
-        p_got_man = del_ack_man[jnp.clip(proxies, 0)]
-        pop_hit = ((p_tgt == jstar[:, None]) & p_got_direct) | (
-            (p_man == jstar[:, None]) & p_got_man
-        )
-        fwd_c = del_pr & pop_hit
-        del_fwd_c = fwd_c & ok_edge(proxies, idx[:, None])
-        fwd = del_pack & ~pop_hit
-        del_fwd = fwd & ok_edge(proxies, idx[:, None])
-
-        # ---- composed write pass: A3 write -> B (Jm, fail) -> wave 1 ->
-        # wave 2 -> gossip insert, with exact per-wave (fp, count) deltas
-        # (the kernel.py _fast composition, generalized to the full tick;
-        # marks write (KNOWN, now, sender identity) in both waves so the
-        # last-writer chain is order-free — see kernel.py for the proofs).
-        # Two builds under one cond: the join build materializes the
-        # reply_del/gossip residents and threads them through; the plain
-        # build (every no-join tick) never touches an [N, N] join buffer.
-        def _make_compose(with_join, reply_del=None, gossip=None):
-            def _compose_rows(s0):
-                Sb, Tb, lb, vb = state_rows(S, T, lat, idv, s0)
-                gi = blk_idx(s0)
-                eye_b = blk_eye(s0)
-
-                tgt_cell = (idx[None, :] == ping_tgt[gi][:, None]) & has_ping[gi][:, None]
-                # mark1[d, s]: datagrams LANDING at rows d of this block.
-                m1 = ((gi[:, None] == ping_tgt[None, :]) & ok_ping[None, :]) | (
-                    (gi[:, None] == man_tgt[None, :]) & ok_man[None, :]
-                )
-                for kk in range(proxies.shape[-1]):
-                    m1 |= (gi[:, None] == proxies[None, :, kk]) & del_pr[None, :, kk]
-                # mark2[s, d]: rows s of this block marking their own targets.
-                m2 = ((idx[None, :] == ping_tgt[gi][:, None]) & del_ack[gi][:, None]) | (
-                    (idx[None, :] == man_tgt[gi][:, None]) & del_ack_man[gi][:, None]
-                )
-                if with_join:
-                    # joiner o (rows of this block) marks each responder r.
-                    m2 |= jax.lax.dynamic_slice_in_dim(reply_del, s0, block, axis=1).T
-
-                def _esc_scatter():
-                    # suspect jstar[i] (a row of this block) marks proxy p.
-                    rows = jnp.clip(jstar - s0, 0, block - 1)
-                    inb = (jstar >= s0) & (jstar < s0 + block)
-                    val = del_pping & inb[:, None]
-                    z = jnp.zeros((block, n), dtype=bool)
-                    return z.at[
-                        jnp.broadcast_to(rows[:, None], proxies.shape),
-                        jnp.clip(proxies, 0),
-                    ].max(val)
-
-                m2 |= jax.lax.cond(
-                    any_esc, _esc_scatter, lambda: jnp.zeros((block, n), bool))
-
-                if with_join:
-                    Jm_b = join_b[None, :] & okT_rows(s0) & ~eye_b
-                else:
-                    Jm_b = jnp.zeros((block, n), bool)
-                fail_b = (_slice_rows(fail_del, s0, block)
-                          if fail_del is not None else None)
-
-                # State after A3 + B (the wave-1 read state).
-                S_B = jnp.where(Jm_b, jnp.int8(KNOWN),
-                                jnp.where(tgt_cell, jnp.int8(WAITING_FOR_PING), Sb))
-                T_B = jnp.where(Jm_b | tgt_cell, tT, Tb)
-                if fail_b is not None:
-                    S_B = jnp.where(fail_b, jnp.int8(0), S_B)
-                m_B = S_B > 0
-                vb_B = jnp.where(Jm_b, id_row, vb) if has_idv else None
-
-                # fp0/n0 on the post-B state (kernel.py fp0 = fp_count post-B).
-                u_row_b = jnp.broadcast_to(idx.astype(jnp.uint32)[None, :], (block, n))
-                if has_idv:
-                    old = jnp.where(m_B, peer_record_hash(u_row_b, vb_B), jnp.uint32(0))
-                    fp0 = jnp.sum(old, axis=-1, dtype=jnp.uint32)
-                    dfp1 = jnp.sum(jnp.where(m1, rec_hash[None, :] - old, jnp.uint32(0)),
-                                   axis=-1, dtype=jnp.uint32)
-                    hash1 = jnp.where(m1, rec_hash[None, :], old)
-                    dfp2 = jnp.sum(jnp.where(m2, rec_hash[None, :] - hash1, jnp.uint32(0)),
-                                   axis=-1, dtype=jnp.uint32)
-                else:
-                    fp0 = jnp.sum(jnp.where(m_B, rec_hash[None, :], jnp.uint32(0)),
-                                  axis=-1, dtype=jnp.uint32)
-                    dfp1 = jnp.sum(
-                        jnp.where(m1 & ~m_B, rec_hash[None, :], jnp.uint32(0)),
-                        axis=-1, dtype=jnp.uint32)
-                    dfp2 = jnp.sum(
-                        jnp.where(m2 & ~(m_B | m1), rec_hash[None, :], jnp.uint32(0)),
-                        axis=-1, dtype=jnp.uint32)
-                n0 = jnp.sum(m_B, axis=-1, dtype=jnp.int32)
-                dn1 = jnp.sum(m1 & ~m_B, axis=-1, dtype=jnp.int32)
-                dn2 = jnp.sum(m2 & ~(m_B | m1), axis=-1, dtype=jnp.int32)
-
-                # Latency EWMA with wave ordering (kernel.py apply_marks).
-                if has_lat:
-                    if fail_b is not None:
-                        lb = jnp.where(fail_b, jnp.nan, lb)
-                    waiting1 = (S_B == WAITING_FOR_PING) | (
-                        S_B == WAITING_FOR_INDIRECT_PING)
-                    sample1 = (t - T_B).astype(jnp.float32)
-                    upd1 = jnp.where(jnp.isnan(lb), sample1,
-                                     jnp.float32(0.8) * sample1 + jnp.float32(0.2) * lb)
-                    lb1 = jnp.where(m1 & waiting1, upd1, lb)
-                    S_1 = jnp.where(m1, jnp.int8(KNOWN), S_B)
-                    T_1 = jnp.where(m1, tT, T_B)
-                    waiting2 = (S_1 == WAITING_FOR_PING) | (
-                        S_1 == WAITING_FOR_INDIRECT_PING)
-                    sample2 = (t - T_1).astype(jnp.float32)
-                    upd2 = jnp.where(jnp.isnan(lb1), sample2,
-                                     jnp.float32(0.8) * sample2 + jnp.float32(0.2) * lb1)
-                    lb = jnp.where(m2 & waiting2, upd2, lb1)
-
-                markK = m1 | m2
-                S_2 = jnp.where(markK, jnp.int8(KNOWN), S_B)
-                T_2 = jnp.where(markK, tT, T_B)
-                if has_idv:
-                    vb = jnp.where(markK, id_row, vb_B)
-
-                if with_join:
-                    g_b = _slice_rows(gossip, s0, block)
-                    g_ins = g_b & ~(S_2 > 0)
-                    S_2 = jnp.where(g_ins, jnp.int8(KNOWN), S_2)
-                    T_2 = jnp.where(g_ins, tT - gossip_backdate, T_2)
-                    if has_idv:
-                        vb = jnp.where(g_ins, id_row, vb)
-
-                out = [S_2, T_2, fp0, n0, dfp1, dn1, dfp2, dn2]
-                if has_lat:
-                    out.append(lb)
-                if has_idv:
-                    out.append(vb)
-                return tuple(out)
-
-            return _compose_rows
-
-        def _compose_plain():
-            res = pmap_blocks(_make_compose(False))
-            out = res + (jnp.int32(0),)  # join-reply message count
-            if telemetry:
-                out = out + (jnp.int32(0),)  # join-share records sent
-            return out
-
-        def _compose_with_join():
-            # row_count_a: membership counts on the post-A2 state (A3 moves
-            # no membership, so this equals kernel.py's post-A3 count).
-            row_count_a = pmap_blocks(
-                lambda s0: jnp.sum(_slice_rows(S, s0, block) > 0,
-                                   axis=-1, dtype=jnp.int32))
-
-            def _reply_rows(s0):
-                # reply_del[r, o] for responder rows r (kaboodle.rs:333-392).
-                ok_b = ok_rows(s0)  # [B(r), N(o)] r -> o unicast gate
-                Jm_b = join_b[None, :] & okT_rows(s0) & ~blk_eye(s0)
-                member_b = _slice_rows(S, s0, block) > 0
-                is_new = Jm_b & ~member_b
-                n_after = (row_count_a[blk_idx(s0)][:, None]
-                           + jnp.cumsum(is_new.astype(jnp.int32), axis=1))
-                reply_p = broadcast_reply_prob(n_after)
-                bern = bernoulli_matrix(
-                    jax.random.fold_in(key_bern, s0 // block),
-                    reply_p, (block, n), det,
-                )
-                reply = is_new & bern
-                if not telemetry:
-                    return reply & ok_b
-                # Records in the join-response shares SENT from these rows
-                # (kernel.py _join_replies' telemetry arithmetic, blocked):
-                # the ``reply`` gate (not reply & ok_b — the response unicast
-                # may still drop), sequential-map size uncapped, D5 cap model
-                # over it.
-                if cfg.max_share_peers:
-                    cap = jnp.int32(cfg.max_share_peers)
-                    within_cap = (
-                        jnp.cumsum(member_b.astype(jnp.int32), axis=1) <= cap
-                    )
-                    base_c = member_b & within_cap
-                    clen = jnp.minimum(
-                        row_count_a[blk_idx(s0)], cap
-                    )[:, None] + jnp.cumsum(
-                        (Jm_b & ~base_c).astype(jnp.int32), axis=1
-                    )
-                    rec_cnt = jnp.where(n_after <= cap, n_after, clen)
-                else:
-                    rec_cnt = n_after
-                recs = jnp.sum(
-                    jnp.where(reply, rec_cnt, 0), axis=-1, dtype=jnp.int32
-                )
-                return reply & ok_b, recs
-
-            if telemetry:
-                reply_del, join_rec_rows = pmap_blocks(_reply_rows)
-                join_records = jnp.sum(join_rec_rows, dtype=jnp.int32)
-            else:
-                reply_del = pmap_blocks(_reply_rows)
-
-            if boot_union:
-                # Closed-form avalanche union (see make_chunked_tick_fn
-                # docstring for the derivation and its precondition).
-                cnt = jnp.sum(reply_del.astype(jnp.int32), axis=0)  # [N(o)]
-
-                def _union_rows_boot(s0):
-                    gi = blk_idx(s0)
-                    repT = jax.lax.dynamic_slice_in_dim(
-                        reply_del, s0, block, axis=1).T  # [B(o), N(j)]
-                    others = (cnt[gi][:, None] - repT.astype(jnp.int32)) > 0
-                    tri = idx[None, :] <= gi[:, None]  # j <= o
-                    return repT | (join_b[None, :] & others & tri)
-
-                gossip = pmap_blocks(_union_rows_boot)
-                res = pmap_blocks(_make_compose(True, reply_del, gossip))
-                out = res + (jnp.sum(reply_del, dtype=jnp.int32),)
-                if telemetry:
-                    out = out + (join_records,)
-                return out
-
-            # Gate the O(N^3) contraction on a reply actually existing (same
-            # rationale as kernel.py _join_replies: a rebroadcast into a
-            # full mesh yields zero new joiners, zero replies, and an
-            # all-False contraction that still costs the full dense time).
-            any_reply = jnp.any(reply_del)
-
-            def _union_rows(s0):
-                # gossip[o, j] for joiner rows o: OR over responders r of
-                # reply_del[r, o] & (share_base[r, j] | (Jm[r, j] & j <= o)).
-                def _accum(acc, r0):
-                    t1, t2 = acc
-                    rep_T = jax.lax.dynamic_slice_in_dim(
-                        reply_del, r0, block, axis=0)
-                    rep_T = jax.lax.dynamic_slice_in_dim(
-                        rep_T, s0, block, axis=1).T  # [B(o), B(r)]
-                    member_r = _slice_rows(S, r0, block) > 0
-                    share_base = member_r
-                    if cfg.max_share_peers and n > cfg.max_share_peers:
-                        within = (jnp.cumsum(member_r.astype(jnp.int32), axis=1)
-                                  <= cfg.max_share_peers)
-                        share_base = member_r & within
-                    Jm_r = join_b[None, :] & okT_rows(r0) & ~blk_eye(r0)
-                    t1 = t1 + _int8_matmul(rep_T, share_base)
-                    t2 = t2 + _int8_matmul(rep_T, Jm_r)
-                    return (t1, t2), None
-
-                z = jnp.zeros((block, n), jnp.int32)
-                (t1, t2), _ = jax.lax.scan(_accum, (z, z), starts)
-                tri = idx[None, :] <= blk_idx(s0)[:, None]  # j <= o
-                return (t1 > 0) | ((t2 > 0) & tri)
-
-            gossip = jax.lax.cond(
-                any_reply,
-                lambda: pmap_blocks(_union_rows),
-                lambda: jnp.zeros((n, n), dtype=bool),
-            )
-            res = pmap_blocks(_make_compose(True, reply_del, gossip))
-            out = res + (jnp.sum(reply_del, dtype=jnp.int32),)
-            if telemetry:
-                out = out + (join_records,)
-            return out
-
-        if cfg.join_broadcast_enabled:
-            comp = jax.lax.cond(any_join, _compose_with_join, _compose_plain)
-        else:
-            comp = _compose_plain()
-        it = iter(comp)
-        S, T = next(it), next(it)
-        fp0, n0, dfp1, dn1, dfp2, dn2 = (next(it) for _ in range(6))
-        lat = next(it) if has_lat else lat
-        idv = next(it) if has_idv else idv
-        if telemetry:
-            msgs_join, join_records = comp[-2], comp[-1]
-        else:
-            msgs_join = comp[-1]
-        fp1, n1 = fp0 + dfp1, n0 + dn1
-
-        # ---- fp2 (escalation-gated full read; kernel.py fp2) ---------------
-        def _fp_rows_of(SS, VV):
-            def _fp_rows(s0):
-                Sb = _slice_rows(SS, s0, block)
-                member = Sb > 0
-                if has_idv:
-                    u_row_b = jnp.broadcast_to(
-                        idx.astype(jnp.uint32)[None, :], (block, n))
-                    contrib = jnp.where(
-                        member,
-                        peer_record_hash(u_row_b, _slice_rows(VV, s0, block)),
-                        jnp.uint32(0))
-                else:
-                    contrib = jnp.where(member, rec_hash[None, :], jnp.uint32(0))
-                return (jnp.sum(contrib, axis=-1, dtype=jnp.uint32),
-                        jnp.sum(member, axis=-1, dtype=jnp.int32))
-            return _fp_rows
-
-        fp2, n2 = jax.lax.cond(
-            any_esc,
-            lambda: pmap_blocks(_fp_rows_of(S, idv)),
-            lambda: (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32)),
-        )
-
-        # ---- calls 3 + 4 (escalation-gated scatter waves) -------------------
-        def _calls34_rows(s0):
-            Sb, Tb, lb, vb = state_rows(S, T, lat, idv, s0)
-            gi = blk_idx(s0)
-
-            def _inblk_scatter(rows, cols, vals):
-                rr = jnp.clip(rows - s0, 0, block - 1)
-                inb = (rows >= s0) & (rows < s0 + block)
-                z = jnp.zeros((block, n), dtype=bool)
-                return z.at[rr, jnp.clip(cols, 0)].max(vals & inb)
-
-            # mark3: proxy marks suspect + suspector marks pinger-proxy.
-            jb = jnp.broadcast_to(jstar[:, None], proxies.shape)
-            ib = jnp.broadcast_to(idx[:, None], proxies.shape)
-            mark3 = _inblk_scatter(proxies, jb, del_pack)
-            mark3 |= _inblk_scatter(ib, proxies, del_fwd_c)
-
-            def _apply(Sb, Tb, lb, vb, mark):
-                if has_lat:
-                    waiting = (Sb == WAITING_FOR_PING) | (
-                        Sb == WAITING_FOR_INDIRECT_PING)
-                    sample = (t - Tb).astype(jnp.float32)
-                    upd = jnp.where(jnp.isnan(lb), sample,
-                                    jnp.float32(0.8) * sample
-                                    + jnp.float32(0.2) * lb)
-                    lb = jnp.where(mark & waiting, upd, lb)
-                if has_idv:
-                    vb = jnp.where(mark, id_row, vb)
-                Sb = jnp.where(mark, jnp.int8(KNOWN), Sb)
-                Tb = jnp.where(mark, tT, Tb)
-                return Sb, Tb, lb, vb
-
-            Sb, Tb, lb, vb = _apply(Sb, Tb, lb, vb, mark3)
-            mark4 = _inblk_scatter(ib, proxies, del_fwd)
-            Sb, Tb, lb, vb = _apply(Sb, Tb, lb, vb, mark4)
-            if not cfg.faithful_indirect_ack:
-                cleared = jnp.any((del_fwd | del_fwd_c)[gi], axis=-1)
-                jcell = idx[None, :] == jstar[gi][:, None]
-                clr = cleared[:, None] & jcell & (Sb > 0)
-                Sb = jnp.where(clr, jnp.int8(KNOWN), Sb)
-                Tb = jnp.where(clr, tT, Tb)
-            return tuple(x for x in (Sb, Tb, lb, vb) if x is not None)
-
-        c34 = jax.lax.cond(
-            any_esc,
-            lambda a: pmap_blocks(_calls34_rows),
-            lambda a: a,
-            tuple(x for x in (S, T, lat, idv) if x is not None),
-        )
-        it = iter(c34)
-        S, T = next(it), next(it)
-        lat = next(it) if has_lat else None
-        idv = next(it) if has_idv else None
-
-        # ---- fp_g (kernel.py: delta chain on quiet ticks, recompute else) --
-        fp_g, n_g = jax.lax.cond(
-            any_join | any_esc,
-            lambda: pmap_blocks(_fp_rows_of(S, idv)),
-            lambda: (fp1 + dfp2, n1 + dn2),
-        )
-
-        # ---- anti-entropy candidate selection (kaboodle.rs:707-740) --------
-        def _prio0_rows(s0):
-            gi = blk_idx(s0)
-            m0 = ((st.kpr_partner[None, :] == gi[:, None])
-                  & alive[gi][:, None] & ~rv[gi][:, None])
-            match0 = m0 & (st.kpr_fp[None, :] != fp_g[gi][:, None]) & (
-                n_g[gi][:, None] <= st.kpr_n[None, :]
-            )
-            return jnp.min(jnp.where(match0, idx[None, :], INF), axis=-1)
-
-        prio0 = pmap_blocks(_prio0_rows)
-        peer0 = prio0
-
-        base1 = jnp.int32(n)
-        m_d = del_ack & (fp1[jnp.clip(ping_tgt, 0)] != fp_g) & (
-            n_g <= n1[jnp.clip(ping_tgt, 0)]
-        )
-        m_m = del_ack_man & (fp1[jnp.clip(man_tgt, 0)] != fp_g) & (
-            n_g <= n1[jnp.clip(man_tgt, 0)]
-        )
-        prio_d = jnp.where(m_d, base1 + ping_tgt, INF)
-        prio_m = jnp.where(m_m, base1 + man_tgt, INF)
-        prio1 = jnp.minimum(prio_d, prio_m)
-        peer1 = jnp.where(prio_d <= prio_m, ping_tgt, man_tgt)
-
-        base2 = jnp.int32(2 * n)
-        x_fp2 = fp2[jnp.clip(jstar, 0)]
-        x_n2 = n2[jnp.clip(jstar, 0)]
-        m_px = del_pack & (x_fp2[:, None] != fp_g[jnp.clip(proxies, 0)]) & (
-            n_g[jnp.clip(proxies, 0)] <= x_n2[:, None]
-        )
-        prio_proxy = jnp.full((n,), INF, dtype=jnp.int32).at[jnp.clip(proxies, 0)].min(
-            jnp.where(m_px, base2 + jstar[:, None], INF)
-        )
-        peer_proxy = prio_proxy - base2
-        x_fp1 = fp1[jnp.clip(jstar, 0)]
-        x_n1 = n1[jnp.clip(jstar, 0)]
-        m_cf = del_fwd_c & (x_fp1[:, None] != fp_g[:, None]) & (
-            n_g[:, None] <= x_n1[:, None]
-        )
-        prio_coinc = jnp.min(jnp.where(m_cf, base2 + proxies, INF), axis=-1)
-        prio2 = jnp.minimum(prio_proxy, prio_coinc)
-        peer2 = jnp.where(prio_proxy <= prio_coinc, peer_proxy, jstar)
-
-        base3 = jnp.int32(3 * n)
-        m_f = del_fwd & (x_fp2[:, None] != fp_g[:, None]) & (
-            n_g[:, None] <= x_n2[:, None]
-        )
-        prio3 = jnp.min(jnp.where(m_f, base3 + proxies, INF), axis=-1)
-        peer3 = jstar
-
-        best = jnp.minimum(jnp.minimum(prio0, prio1), jnp.minimum(prio2, prio3))
-        partner = jnp.where(
-            best == prio0, peer0,
-            jnp.where(best == prio1, peer1, jnp.where(best == prio2, peer2, peer3)),
-        ).astype(jnp.int32)
-        has_req = (best != INF) & alive
-        partner = jnp.where(has_req, partner, -1)
-        del_kpr = has_req & ok_edge(idx, partner)
-        del_rep = del_kpr & ok_edge(partner, idx)
-
-        # ---- call-G apply (gated; two passes so the share snapshot is the
-        # post-mark_g state, exactly the oracle's two-pass order) ------------
-        def _g1_rows(s0):
-            Sb, Tb, lb, vb = state_rows(S, T, lat, idv, s0)
-            gi = blk_idx(s0)
-            mark_g = (gi[:, None] == partner[None, :]) & del_kpr[None, :]
-            if has_lat:
-                waiting = (Sb == WAITING_FOR_PING) | (
-                    Sb == WAITING_FOR_INDIRECT_PING)
-                sample = (t - Tb).astype(jnp.float32)
-                upd = jnp.where(jnp.isnan(lb), sample,
-                                jnp.float32(0.8) * sample + jnp.float32(0.2) * lb)
-                lb = jnp.where(mark_g & waiting, upd, lb)
-            if has_idv:
-                vb = jnp.where(mark_g, id_row, vb)
-            Sb = jnp.where(mark_g, jnp.int8(KNOWN), Sb)
-            Tb = jnp.where(mark_g, tT, Tb)
-            return tuple(x for x in (Sb, Tb, lb, vb) if x is not None)
-
-        def _g_phase(args):
-            g1 = pmap_blocks(_g1_rows)
-            it = iter(g1)
-            S1, T1 = next(it), next(it)
-            l1 = next(it) if has_lat else None
-            v1 = next(it) if has_idv else None
-
-            def _g2_rows(s0):
-                Sb = _slice_rows(S1, s0, block)
-                Tb = _slice_rows(T1, s0, block)
-                vb = _slice_rows(v1, s0, block) if has_idv else None
-                gi = blk_idx(s0)
-                pt = partner[gi]
-                mark_rep = (idx[None, :] == pt[:, None]) & del_rep[gi][:, None]
-                Sb2 = jnp.where(mark_rep, jnp.int8(KNOWN), Sb)
-                Tb2 = jnp.where(mark_rep, tT, Tb)
-                # Filtered share from the partner's post-mark_g row
-                # (kaboodle.rs:483-501): reads the PASS INPUT (= post-G1,
-                # pre-mark_rep) rows, the exact S_share snapshot.
-                Sg = S1[jnp.clip(pt, 0)]
-                Tg = T1[jnp.clip(pt, 0)]
-                share = (Sg == KNOWN) & (idx[None, :] != pt[:, None]) & (
-                    (t - Tg) < cfg.max_peer_share_age_ticks
-                )
-                rep_ins = (del_rep[gi][:, None] & share
-                           & ~blk_eye(s0) & ~(Sb2 > 0))
-                Sb2 = jnp.where(rep_ins, jnp.int8(KNOWN), Sb2)
-                Tb2 = jnp.where(rep_ins, tT - gossip_backdate, Tb2)
-                out = [Sb2, Tb2]
-                if has_idv:
-                    out.append(jnp.where(rep_ins, id_row, vb))
-                if telemetry:
-                    # Records in the replies these requesters' partners SENT
-                    # (kernel.py _g_apply telemetry): every delivered request
-                    # is answered; ``share`` already excludes the partner's
-                    # self-entry, the requester's own column is subtracted.
-                    own = share[jnp.arange(block, dtype=jnp.int32), gi]
-                    out.append(jnp.where(
-                        del_kpr[gi],
-                        jnp.sum(share, axis=-1, dtype=jnp.int32)
-                        - own.astype(jnp.int32),
-                        0,
-                    ))
-                return tuple(out)
-
-            g2 = pmap_blocks(_g2_rows)
-            it = iter(g2)
-            S2, T2 = next(it), next(it)
-            v2 = next(it) if has_idv else None
-            ae_rows = next(it) if telemetry else None
-            fp_f, n_f = pmap_blocks(_fp_rows_of(S2, v2))
-            out = [S2, T2, fp_f, n_f]
-            if has_lat:
-                out.append(l1)
-            if has_idv:
-                out.append(v2)
-            if telemetry:
-                out.append(jnp.sum(ae_rows, dtype=jnp.int32))
-            return tuple(out)
-
-        def _g_skip(args):
-            out = [S, T, fp_g, n_g]
-            if has_lat:
-                out.append(lat)
-            if has_idv:
-                out.append(idv)
-            if telemetry:
-                out.append(jnp.int32(0))
-            return tuple(out)
-
-        gph = jax.lax.cond(jnp.any(del_kpr), _g_phase, _g_skip, ())
-        it = iter(gph)
-        S, T, fp_f, n_f = next(it), next(it), next(it), next(it)
-        lat = next(it) if has_lat else None
-        idv = next(it) if has_idv else None
-        ae_records = gph[-1] if telemetry else None
-
-        # ---- metrics + next state (kernel.py _finish) ----------------------
-        msgs = (
-            jnp.sum(ok_ping, dtype=jnp.int32)
-            + jnp.sum(ok_man, dtype=jnp.int32)
-            + jnp.sum(del_pr, dtype=jnp.int32)
-            + jnp.sum(del_ack, dtype=jnp.int32)
-            + jnp.sum(del_ack_man, dtype=jnp.int32)
-            + jnp.sum(del_pping, dtype=jnp.int32)
-            + jnp.sum(del_pack, dtype=jnp.int32)
-            + jnp.sum(del_fwd_c, dtype=jnp.int32)
-            + jnp.sum(del_fwd, dtype=jnp.int32)
-            + jnp.sum(del_kpr, dtype=jnp.int32)
-            + jnp.sum(del_rep, dtype=jnp.int32)
-            + msgs_join
-        )
-
-        converged, fpa_min, fpa_max, n_alive = fingerprint_agreement(alive, fp_f)
-        agree = jnp.sum(alive & (fp_f == fpa_min), dtype=jnp.int32)
-        new_state = MeshState(
-            state=S, timer=T, alive=alive, identity=st.identity,
-            never_broadcast=never_b, last_broadcast=last_b,
-            kpr_partner=jnp.where(del_kpr, partner, -1),
-            kpr_fp=fp_g, kpr_n=n_g, tick=t + 1, key=key_next,
-            latency=lat, id_view=idv,
-        )
-        metrics = TickMetrics(
-            messages_delivered=msgs,
-            converged=converged,
-            agree_fraction=agree.astype(jnp.float32) / jnp.maximum(n_alive, 1),
-            # f32 accumulation: an int32 sum wraps at the N=65,536 scale this
-            # kernel exists for (65,536 alive x 65,536 members > 2^31); the
-            # ~1e-7 relative f32 error is noise on a mean.
-            mean_membership=jnp.sum(jnp.where(alive, n_f, 0).astype(jnp.float32))
-            / jnp.maximum(n_alive, 1),
-            fingerprint_min=fpa_min,
-            fingerprint_max=fpa_max,
-        )
-        if not telemetry:
-            return new_state, metrics
-
-        # ---- telemetry counters (kernel.py's definitions, blocked) ---------
-        # A2 removals, recomputed from the pre-tick snapshot only on ticks
-        # where A2 fired (the two terms are disjoint — kernel.py note).
-        def _wfip_cells(s0):
-            Sb = _slice_rows(S0, s0, block)
-            Tb = _slice_rows(T0, s0, block)
-            return jnp.sum(
-                alive[blk_idx(s0)][:, None]
-                & (Sb == WAITING_FOR_INDIRECT_PING)
-                & ((t - Tb) >= cfg.ping_timeout_ticks),
-                axis=-1,
-                dtype=jnp.int32,
-            )
-
-        deaths = jax.lax.cond(
-            any_a2,
-            lambda: jnp.sum(pmap_blocks(_wfip_cells), dtype=jnp.int32)
-            + jnp.sum(insta_remove, dtype=jnp.int32),
-            lambda: jnp.int32(0),
-        )
-        if cfg.join_broadcast_enabled:
-            joins_diss = jax.lax.cond(
-                any_join,
-                lambda: jnp.sum(
-                    pmap_blocks(
-                        lambda s0: jnp.sum(
-                            join_b[None, :] & okT_rows(s0) & ~blk_eye(s0),
-                            axis=-1,
-                            dtype=jnp.int32,
-                        )
-                    ),
-                    dtype=jnp.int32,
-                ),
-                lambda: jnp.int32(0),
-            )
-        else:
-            joins_diss = jnp.int32(0)
-        counters = ProtocolCounters(
-            pings_sent=jnp.sum(has_ping, dtype=jnp.int32)
-            + jnp.sum(man_tgt >= 0, dtype=jnp.int32)
-            + jnp.sum(del_pr, dtype=jnp.int32),
-            acks_sent=jnp.sum(ok_ping, dtype=jnp.int32)
-            + jnp.sum(ok_man, dtype=jnp.int32)
-            + jnp.sum(del_pping, dtype=jnp.int32)
-            + jnp.sum(fwd, dtype=jnp.int32)
-            + jnp.sum(fwd_c, dtype=jnp.int32),
-            ping_reqs_sent=jnp.sum(proxies_valid, dtype=jnp.int32),
-            suspicions_raised=jnp.sum(escalate, dtype=jnp.int32),
-            suspicions_refuted=jnp.sum(
-                (S0 == WAITING_FOR_INDIRECT_PING) & (S == KNOWN),
-                dtype=jnp.int32,
-            ),
-            deaths_declared=deaths,
-            joins_disseminated=joins_diss,
-            gossip_bytes=jnp.uint32(RECORD_BYTES)
-            * (ae_records + join_records).astype(jnp.uint32),
-            armed_timers=jnp.sum(
-                alive[:, None]
-                & ((S == WAITING_FOR_PING) | (S == WAITING_FOR_INDIRECT_PING)),
-                dtype=jnp.int32,
-            ),
-        )
-        return new_state, TickTelemetry(metrics=metrics, counters=counters, fp=fp_f)
-
-    return tick
+__all__ = ["make_chunked_tick_fn"]

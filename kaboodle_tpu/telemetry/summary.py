@@ -30,10 +30,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="+", metavar="MANIFEST.jsonl")
     p.add_argument("--trace", metavar="OUT.json", default=None,
                    help="export tick records as Chrome-trace/Perfetto JSON")
+    p.add_argument("--phase-program", default="fused",
+                   choices=("fused", "full", "span", "blocked", "off"),
+                   help="with --trace: annotate each run track with per-pass "
+                        "slices from the phase-graph plan of this mode "
+                        "(default-config graph; 'off' disables the track)")
     p.add_argument("--check", action="store_true",
                    help="schema gate: exit nonzero unless every record "
                         "validates and at least one record exists")
     return p
+
+
+def _phase_program(mode: str):
+    """The planned phase-graph program whose passes annotate the trace.
+
+    Built from the default deterministic config's op graph (plan/graph are
+    pure metadata — no jax import, no tracing): pass membership and pruning
+    are decided by the planner per mode, not per run, so the default build's
+    plan is the right annotation for any run of that mode. ``span`` plans
+    derive from the fault-free graph by definition (a quiescent span carries
+    no scheduled events)."""
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.phasegraph import build_graph, plan
+
+    graph = build_graph(
+        SwimConfig(deterministic=True), faulty=(mode != "span"), telemetry=True
+    )
+    return plan(graph, mode)
 
 
 def load_manifests(paths: list[str]) -> dict[str, list[dict]]:
@@ -119,9 +142,14 @@ def main(argv=None) -> int:
             path: [r for r in recs if r["kind"] == "tick"]
             for path, recs in records.items()
         }
+        program = (
+            None if args.phase_program == "off"
+            else _phase_program(args.phase_program)
+        )
         n = write_chrome_trace(args.trace,
                                {p: rows for p, rows in groups.items() if rows},
-                               metadata={"manifests": args.paths})
+                               metadata={"manifests": args.paths},
+                               program=program)
         print(f"  trace: {n} events -> {args.trace}")
         summary["trace_events"] = n
 

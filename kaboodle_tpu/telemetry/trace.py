@@ -13,7 +13,14 @@ Two complementary halves:
   (manifest ``tick`` records, or anything shaped like them) into Chrome
   trace events: one ``X`` slice per tick on a "protocol" track (leaped gaps
   become ``leap`` slices), one ``C`` counter series per ProtocolCounters
-  field. :func:`write_chrome_trace` wraps them in the JSON object format
+  field. :func:`phase_slice_events` adds a second thread of **per-pass
+  slices sourced from the phase graph**: given a planned
+  :class:`~kaboodle_tpu.phasegraph.plan.TickProgram` (or its ``describe()``
+  dict), each tick's slice is subdivided into that program's executable
+  passes, each pass slice naming the phase ops that landed in it — so a
+  fused-program trace shows exactly which of the two passes (draw / update)
+  each SWIM phase folded into, and which ops the dispatch predicate pruned.
+  :func:`write_chrome_trace` wraps everything in the JSON object format
   that chrome://tracing and https://ui.perfetto.dev load directly. The
   timeline unit is simulated ticks (1 tick == 1 ms display time), not wall
   clock — this is the *protocol* timeline; for device wall time use
@@ -94,19 +101,80 @@ def chrome_trace_events(tick_rows, pid: int = 1, label: str | None = None) -> li
     return events
 
 
-def write_chrome_trace(path: str, tick_rows, metadata: dict | None = None) -> int:
+def phase_slice_events(program, tick_rows, pid: int = 1) -> list[dict]:
+    """Per-tick **pass** slices derived from a planned phase-graph program.
+
+    ``program`` is a :class:`~kaboodle_tpu.phasegraph.plan.TickProgram` or
+    its ``describe()`` dict — the one source of truth for which fused pass
+    each phase op landed in. Each tick present in ``tick_rows`` gets its
+    1 ms subdivided equally among the program's executable passes (prologue
+    then tail, in execution order) on a second thread of the same process
+    track; a pass slice's args carry its op names. Pruned ops (the
+    rare-phase work the dispatch predicate excludes from the fused program)
+    are rendered once as an instant event at the first tick, with the
+    predicate terms that guard their absence.
+
+    Equal subdivision is deliberate: this is the *protocol* timeline (pass
+    structure and op membership), not a wall-clock profile — per-pass wall
+    time lives in the jax profiler capture, where the same op names appear
+    as ``kaboodle:`` named scopes.
+    """
+    desc = program.describe() if hasattr(program, "describe") else program
+    passes = desc["passes"]
+    events: list[dict] = [
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 2,
+         "args": {"name": f"phase passes ({desc['mode']})"}},
+    ]
+    ticks = sorted(int(r["tick"]) for r in tick_rows)
+    if ticks and desc.get("pruned"):
+        events.append({
+            "name": "pruned", "ph": "i", "s": "t", "pid": pid, "tid": 2,
+            "ts": ticks[0] * _TICK_US,
+            "args": {
+                "ops": {p["op"]: p["reason"] for p in desc["pruned"]},
+                "pred_terms": list(desc.get("pred_terms", ())),
+            },
+        })
+    width = _TICK_US // max(len(passes), 1)
+    for t in ticks:
+        for j, p in enumerate(passes):
+            events.append({
+                "name": f"{p['stage']}:{p['name']}", "ph": "X",
+                "pid": pid, "tid": 2,
+                "ts": t * _TICK_US + j * width, "dur": width,
+                "args": {"ops": list(p["ops"])},
+            })
+    return events
+
+
+def write_chrome_trace(
+    path: str, tick_rows, metadata: dict | None = None, program=None
+) -> int:
     """Write rows as a Chrome-trace JSON file; returns the event count.
 
     ``tick_rows`` is either one run's rows, or a ``{label: rows}`` mapping
     of several runs — each mapping entry gets its own pid (Perfetto process
     track), so independent runs' ticks never interleave into each other's
-    leap-gap inference."""
+    leap-gap inference. ``program`` (optional) is a planned phase-graph
+    program (or its ``describe()`` dict): each run track then gets a second
+    thread of per-pass slices (:func:`phase_slice_events`) showing which
+    pass each phase op landed in; the program structure is also embedded in
+    ``otherData.phase_program``."""
     if isinstance(tick_rows, dict):
         events = []
         for i, (label, rows) in enumerate(tick_rows.items(), start=1):
+            rows = list(rows)
             events.extend(chrome_trace_events(rows, pid=i, label=str(label)))
+            if program is not None:
+                events.extend(phase_slice_events(program, rows, pid=i))
     else:
+        tick_rows = list(tick_rows)
         events = chrome_trace_events(tick_rows)
+        if program is not None:
+            events.extend(phase_slice_events(program, tick_rows))
+    if program is not None:
+        desc = program.describe() if hasattr(program, "describe") else program
+        metadata = {**(metadata or {}), "phase_program": desc}
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",

@@ -436,6 +436,12 @@ def test_kb302_oracle_and_fleet_stats_in_scope():
         "kaboodle_tpu/oracle/engine.py",
         "kaboodle_tpu/oracle/lockstep.py",
         "kaboodle_tpu/fleet/stats.py",
+        # phasegraph/: the derived-engine bodies every parity pin now
+        # compares — the one place a dtype drift lands in all five
+        # compiled program families at once.
+        "kaboodle_tpu/phasegraph/exec.py",
+        "kaboodle_tpu/phasegraph/blocked.py",
+        "kaboodle_tpu/phasegraph/span.py",
     ):
         assert "KB302" in rules_of(src, path), path
     # analysis/core.py (outside HOT_DIRS) must not collide with fleet/core.py

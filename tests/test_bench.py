@@ -67,3 +67,19 @@ def test_is_size_ceiling_matches_http_500_only():
     assert not _is_size_ceiling(
         RuntimeError("failed to compile kernel.py:500: bad operand"))
     assert not _is_size_ceiling(RuntimeError("HTTP 500 from unrelated service"))
+
+
+def test_bench_fastpath_ab_section():
+    """The --fastpath-ab lane at toy scale: three bit-exact arms and the
+    planner-sourced pass table riding along (full=15 passes incl. the four
+    rare-phase ops the fused plan prunes into its dispatch predicate)."""
+    from bench import _bench_fastpath_ab
+
+    r = _bench_fastpath_ab(64, 8)
+    assert r["bit_exact"] is True
+    assert r["passes_full"] > r["passes_fused"]
+    assert set(r["pruned"]) == {
+        "suspicion", "join_insert", "join_replies", "calls34"
+    }
+    for k in ("full_wall_s", "dispatched_wall_s", "fused_wall_s", "speedup"):
+        assert r[k] > 0

@@ -107,3 +107,13 @@ def test_two_instance_live_demo():
         for out in outs
     }
     assert len(fps) == 1
+
+
+def test_cli_dispatches_phasegraph_subcommand(capsys):
+    """`python -m kaboodle_tpu phasegraph` — every derived engine built at
+    toy N and bit-diffed against dense, exit 0 on exactness."""
+    from kaboodle_tpu.cli import main
+
+    assert main(["phasegraph", "--n", "16", "--ensemble", "2", "--leap", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "fused" in out and "warp" in out and '"ok": true' in out

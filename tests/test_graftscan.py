@@ -138,7 +138,7 @@ def test_kb401_lean_widening_detector():
 def test_real_lean_tick_widenings_are_allowlisted():
     """The production lean tick's only int16 widenings are the documented
     age computations — the detector must stay quiet on them."""
-    entry = select_entries(["sim.tick.dense.lean"])[0]
+    entry = select_entries(["phasegraph.tick.lean"])[0]
     from kaboodle_tpu.analysis.ir.passes import check_kb401_lean_widening
 
     assert check_kb401_lean_widening(entry, trace_entry(entry)) == []
@@ -165,7 +165,7 @@ def test_mutation_host_callback_turns_kb402_red():
 
 
 def test_clean_tick_has_no_kb402():
-    entry = select_entries(["sim.tick.dense.faulty"])[0]
+    entry = select_entries(["phasegraph.tick.faulty"])[0]
     from kaboodle_tpu.analysis.ir.passes import check_kb402_host_boundary
 
     assert check_kb402_host_boundary(entry, trace_entry(entry)) == []
@@ -251,7 +251,7 @@ def test_kb404_derived_spec_and_missing_constraints():
 def test_real_sharded_entries_pass_kb404():
     from kaboodle_tpu.analysis.ir.passes import check_kb404_sharding_specs
 
-    for name in ("parallel.tick.sharded", "warp.leap.sharded"):
+    for name in ("phasegraph.tick.sharded", "phasegraph.leap.sharded"):
         entry = select_entries([name])[0]
         assert check_kb404_sharding_specs(entry, trace_entry(entry)) == []
 

@@ -1,0 +1,269 @@
+"""Phase-graph IR: the op table, the planner, and the derived programs.
+
+The metadata layer (ops/graph/plan) is jax-free, so most of this file runs
+at AST-adjacent cost; the derivation pins at the end trace/execute the real
+programs at toy N. The at-scale bit-exactness contracts live in the parity
+suites (test_kernel_parity.py, test_chunked.py, test_warp.py,
+test_fleet.py, test_fuzz_parity.py) — all of which now execute
+phase-graph-derived engines through the historical shim imports.
+"""
+
+import dataclasses
+
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.phasegraph import TickGraph, build_graph, plan
+from kaboodle_tpu.phasegraph.graph import GraphError
+from kaboodle_tpu.phasegraph.ops import PhaseOp
+from kaboodle_tpu.phasegraph.plan import MODES
+
+
+def _cfg(**kw):
+    return SwimConfig(deterministic=True, **kw)
+
+
+def _op(name, stage, **kw):
+    kw.setdefault("phase", "-")
+    kw.setdefault("doc", name)
+    return PhaseOp(
+        name=name, stage=stage,
+        phase=kw["phase"], doc=kw["doc"],
+        reads=frozenset(kw.get("reads", ())),
+        writes=frozenset(kw.get("writes", ())),
+        inputs=frozenset(kw.get("inputs", ())),
+        gives=frozenset(kw.get("gives", ())),
+        takes=frozenset(kw.get("takes", ())),
+        activity=kw.get("activity", "always"),
+        pred_term=kw.get("pred_term"),
+        mask_rank=kw.get("mask_rank", 1),
+        span=kw.get("span", "invariant"),
+        cut=kw.get("cut"),
+    )
+
+
+# ---- op-table / graph validation ------------------------------------------
+
+
+def test_default_graph_validates_and_orders():
+    g = build_graph(_cfg(), faulty=True)
+    names = [op.name for op in g.ops]
+    assert names.index("rng_split") < names.index("probe_draw")
+    assert names.index("probe_draw") < names.index("call1") < names.index("finish")
+    # the dispatch boundary is real: every prologue op precedes every tail op
+    last_prologue = max(names.index(o.name) for o in g.prologue)
+    first_tail = min(names.index(o.name) for o in g.tail)
+    assert last_prologue < first_tail
+    # cut labels are the stage-probe vocabulary, unique, on tail ops
+    assert set(g.cut_labels) == {"A", "c1", "c2", "c34", "G"}
+
+
+def test_static_flags_decide_op_presence():
+    assert any(o.name == "churn" for o in build_graph(_cfg(), faulty=True).ops)
+    assert not any(o.name == "churn" for o in build_graph(_cfg(), faulty=False).ops)
+    no_join = build_graph(_cfg(join_broadcast_enabled=False), faulty=True)
+    assert not any(o.name.startswith("join") for o in no_join.ops)
+    telem = build_graph(_cfg(), faulty=True, telemetry=True)
+    assert any(o.name == "counters" for o in telem.ops)
+    assert not any(o.name == "counters" for o in build_graph(_cfg()).ops)
+
+
+def test_graph_rejects_duplicate_op():
+    a = _op("a", "prologue", gives=("x",))
+    with pytest.raises(GraphError, match="duplicate"):
+        TickGraph(ops=(a, a), faulty=False, telemetry=False)
+
+
+def test_graph_rejects_take_before_give():
+    a = _op("a", "prologue", takes=("x",))
+    with pytest.raises(GraphError, match="before any op gives"):
+        TickGraph(ops=(a,), faulty=False, telemetry=False)
+
+
+def test_graph_rejects_regive_and_late_prologue():
+    a = _op("a", "prologue", gives=("x",))
+    b = _op("b", "prologue", gives=("x",))
+    with pytest.raises(GraphError, match="re-gives"):
+        TickGraph(ops=(a, b), faulty=False, telemetry=False)
+    t = _op("t", "tail")
+    c = _op("c", "prologue")
+    with pytest.raises(GraphError, match="after the dispatch boundary"):
+        TickGraph(ops=(a, t, c), faulty=False, telemetry=False)
+
+
+def test_op_rejects_unknown_fields_and_bad_enums():
+    with pytest.raises(ValueError, match="unknown state fields"):
+        _op("x", "tail", reads=("no_such_plane",))
+    with pytest.raises(ValueError, match="bad stage"):
+        _op("x", "middle")
+    with pytest.raises(ValueError, match="bad span fate"):
+        _op("x", "tail", span="sometimes")
+
+
+# ---- the planner -----------------------------------------------------------
+
+
+def test_full_plan_is_one_pass_per_op():
+    g = build_graph(_cfg(), faulty=True)
+    prog = plan(g, "full")
+    assert prog.mode == "full"
+    assert len(prog.passes) == len(g.ops)
+    assert prog.op_names() == tuple(op.name for op in g.ops)
+    assert prog.pruned == () and prog.pred_terms == ()
+
+
+def test_fused_plan_prunes_rank2_tail_and_derives_predicate():
+    g = build_graph(_cfg(), faulty=True)
+    prog = plan(g, "fused")
+    pruned = {name for name, _ in prog.pruned}
+    # exactly the rank-2 tail ops are pruned...
+    assert pruned == {o.name for o in g.tail if o.mask_rank == 2}
+    assert {"suspicion", "calls34", "join_insert", "join_replies"} <= pruned
+    # ...and the dispatch predicate is the union of their pred_terms
+    assert set(prog.pred_terms) == {
+        o.pred_term for o in g.tail if o.mask_rank == 2
+    }
+    assert set(prog.pred_terms) == {"any_a2", "any_join"}
+    # the tail is exactly the 2-pass shape: draw, then one folded update
+    assert [p.name for p in prog.tail] == ["draw", "update"]
+    assert "probe_draw" in prog.tail[0].op_names
+    assert {"call1", "call2", "anti_entropy", "finish"} <= set(
+        prog.tail[1].op_names
+    )
+
+
+def test_fused_plan_without_join_plane_shrinks_predicate():
+    g = build_graph(_cfg(join_broadcast_enabled=False), faulty=True)
+    prog = plan(g, "fused")
+    assert set(prog.pred_terms) == {"any_a2"}
+
+
+def test_fused_plan_rejects_unexcludable_rank2_op():
+    g = build_graph(_cfg(), faulty=False)
+    bad = _op("rogue", "tail", mask_rank=2)  # no pred_term
+    with pytest.raises(GraphError, match="neither fold nor be excluded"):
+        plan(
+            TickGraph(ops=g.ops + (bad,), faulty=False, telemetry=False),
+            "fused",
+        )
+
+
+def test_span_plan_requires_fault_free_graph():
+    with pytest.raises(GraphError, match="fault-free"):
+        plan(build_graph(_cfg(), faulty=True), "span")
+    prog = plan(build_graph(_cfg(), faulty=False), "span")
+    pruned = {name for name, _ in prog.pruned}
+    # quiescence prunes the rare phases; the probe draw stays live
+    assert "suspicion" in pruned and "calls34" in pruned
+    live_ops = {n for p in prog.tail for n in p.op_names}
+    assert "probe_draw" in live_ops and "finish" in live_ops
+
+
+def test_blocked_plan_shares_full_pass_structure():
+    g = build_graph(_cfg(), faulty=True)
+    full, blocked = plan(g, "full"), plan(g, "blocked")
+    assert blocked.mode == "blocked"
+    assert blocked.op_names() == full.op_names()
+    assert [p.name for p in blocked.passes] == [p.name for p in full.passes]
+
+
+def test_plan_rejects_unknown_mode():
+    g = build_graph(_cfg(), faulty=True)
+    with pytest.raises(ValueError, match="unknown plan mode"):
+        plan(g, "turbo")
+    assert set(MODES) == {"full", "fused", "span", "blocked"}
+
+
+def test_describe_is_jsonable_and_names_passes():
+    import json
+
+    prog = plan(build_graph(_cfg(), faulty=True), "fused")
+    desc = json.loads(json.dumps(prog.describe()))
+    assert desc["mode"] == "fused"
+    stages = {p["name"]: p["stage"] for p in desc["passes"]}
+    assert stages["draw"] == "tail" and stages["update"] == "tail"
+    assert {p["op"] for p in desc["pruned"]} == {n for n, _ in prog.pruned}
+    assert prog.pass_of("call1") == "update"
+    with pytest.raises(KeyError):
+        prog.pass_of("suspicion")  # pruned ops are in no pass
+
+
+# ---- derivations execute the plans ----------------------------------------
+
+
+def test_every_build_variant_plans_and_builds():
+    """Every static build variant's graph must validate AND have a full
+    complement of op bodies in exec.py (make_tick_fn cross-checks the plan
+    against its implementation table at build time)."""
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    for kw in (
+        dict(faulty=True),
+        dict(faulty=False),
+        dict(faulty=True, telemetry=True),
+        dict(faulty=False, telemetry=True),
+    ):
+        make_tick_fn(_cfg(), **kw)
+        make_tick_fn(_cfg(join_broadcast_enabled=False), **kw)
+    make_tick_fn(SwimConfig(deterministic=False), faulty=True)
+
+
+def test_tick_fn_exposes_its_graph_and_programs():
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+
+    tick = make_tick_fn(_cfg(), faulty=True)
+    assert {op.name for op in tick.graph.ops} >= {"probe_draw", "call1", "finish"}
+    assert set(tick.programs) == {"full", "fused"}
+    assert [p.name for p in tick.programs["fused"].tail] == ["draw", "update"]
+
+
+@pytest.mark.parametrize("faulty", [True, False])
+def test_fused_program_matches_dispatched_on_steady_ticks(faulty):
+    """The standalone 2-pass fused program equals the dispatched build
+    tick-for-tick on a steady lane (the --fastpath-ab bit-check, in
+    miniature, both faulty and fault-free builds)."""
+    import jax
+
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick, make_fused_tick
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    n = 32
+    st_a = st_b = init_state(n, seed=3, ring_contacts=n - 1, announced=True)
+    idle = idle_inputs(n)
+    dense = jax.jit(make_dense_tick(_cfg(), faulty=faulty))
+    fused = jax.jit(make_fused_tick(_cfg(), faulty=faulty))
+    for _ in range(4):
+        st_a, m_a = dense(st_a, idle)
+        st_b, m_b = fused(st_b, idle)
+    import numpy as np
+
+    for a, b in zip(jax.tree.leaves((st_a, m_a)), jax.tree.leaves((st_b, m_b))):
+        av, bv = np.asarray(a), np.asarray(b)
+        if np.issubdtype(av.dtype, np.floating):
+            assert ((av == bv) | (np.isnan(av) & np.isnan(bv))).all()
+        else:
+            assert (av == bv).all()
+
+
+def test_full_program_build_matches_fast_path_off():
+    """program='full' is exactly the cfg.fast_path=False build (the
+    pre-refactor multi-pass production shape the A/B baselines against)."""
+    import jax
+
+    from kaboodle_tpu.phasegraph.exec import make_tick_fn
+    from kaboodle_tpu.sim.state import idle_inputs, init_state
+
+    n = 32
+    st = init_state(n, seed=5)
+    idle = idle_inputs(n)
+    a = jax.jit(make_tick_fn(_cfg(), faulty=True, program="full"))(st, idle)
+    off = dataclasses.replace(_cfg(), fast_path=False)
+    b = jax.jit(make_tick_fn(off, faulty=True))(st, idle)
+    import numpy as np
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        xv, yv = np.asarray(x), np.asarray(y)
+        if np.issubdtype(xv.dtype, np.floating):
+            assert ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+        else:
+            assert (xv == yv).all()

@@ -385,6 +385,47 @@ def test_write_chrome_trace_groups_keep_runs_on_separate_tracks(tmp_path):
     assert names == {"dense.jsonl", "warp.jsonl"}
 
 
+def test_phase_slice_events_show_fused_pass_membership():
+    """The per-pass track is sourced from the planner: every fused-program
+    tail op lands in exactly the draw or update pass, pruned rare-phase ops
+    appear once with the predicate terms that exclude them, and each tick
+    gets one slice per executable pass."""
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.phasegraph import build_graph, plan
+    from kaboodle_tpu.telemetry.trace import phase_slice_events
+
+    prog = plan(build_graph(SwimConfig(deterministic=True), faulty=True), "fused")
+    rows = [{"tick": 0}, {"tick": 1}]
+    events = phase_slice_events(prog, rows)
+    slices = [e for e in events if e["ph"] == "X"]
+    # one slice per (tick, pass), all on the dedicated phases thread
+    assert len(slices) == 2 * len(prog.passes)
+    assert {e["tid"] for e in slices} == {2}
+    by_name = {e["name"]: e["args"]["ops"] for e in slices}
+    assert "probe_draw" in by_name["tail:draw"]
+    assert "call1" in by_name["tail:update"] and "call2" in by_name["tail:update"]
+    # the pruned instant event names the dispatch-pred terms
+    pruned = [e for e in events if e["name"] == "pruned"]
+    assert len(pruned) == 1
+    assert "suspicion" in pruned[0]["args"]["ops"]
+    assert set(pruned[0]["args"]["pred_terms"]) == set(prog.pred_terms)
+
+
+def test_write_chrome_trace_with_program_embeds_and_annotates(tmp_path):
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.phasegraph import build_graph, plan
+
+    prog = plan(build_graph(SwimConfig(deterministic=True), faulty=True), "fused")
+    path = str(tmp_path / "trace.json")
+    write_chrome_trace(path, [{"tick": 0, "acks_sent": 1}], program=prog)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["otherData"]["phase_program"]["mode"] == "fused"
+    pass_slices = [e for e in doc["traceEvents"]
+                   if e.get("tid") == 2 and e["ph"] == "X"]
+    assert len(pass_slices) == len(prog.passes)
+
+
 # ---- summarizer CLI --------------------------------------------------------
 
 

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -60,6 +60,7 @@ ci: lint native test
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 	$(MAKE) fleet-dryrun
 	$(MAKE) warp-dryrun
+	$(MAKE) warp2-dryrun
 	$(MAKE) telemetry-dryrun
 	$(MAKE) phasegraph-dryrun
 
@@ -76,6 +77,18 @@ fleet-dryrun:
 # (PERF.md "Warp"); CI only proves the lane runs end-to-end.
 warp-dryrun:
 	timeout 300 $(PYTHON) bench.py --warp --platform cpu --n 256 --ticks 64
+
+# Warp 2.0 dryrun (signature-classed fast-forward, ISSUE 8) at toy scale:
+# the churn-recovery lane end-to-end — hybrid + strict spans leap, final
+# states bit-diffed vs dense (bench exits nonzero on any mismatch), the
+# bounded program cache asserted from the inside (ProgramCache stats) —
+# plus the calm-window A/B on the mid-drain state shape. The measured
+# >= 10x acceptance run is the full-size
+# `python bench.py --warp --scenario churn-recovery --platform cpu`
+# (PERF.md "Warp 2.0"); CI only proves the lane + its invariants.
+warp2-dryrun:
+	timeout 420 $(PYTHON) bench.py --warp --scenario churn-recovery \
+	  --platform cpu --n 128 --ticks 1536
 
 # Telemetry dryrun (kaboodle_tpu/telemetry) at toy scale: a dense run and a
 # warped run each write a JSONL manifest (counters + flight-recorder dump),

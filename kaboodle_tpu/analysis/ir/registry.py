@@ -221,6 +221,42 @@ def _leap_lean():
     return make_warp_leap(_cfg(), LEAP_K), (_lean_state(converged=True),)
 
 
+def _leap_hybrid():
+    # Warp 2.0: the near-quiescent span program (strict span + sterile
+    # anti-entropy pass + kpr ledger carry). Tracing is abstract, so the
+    # converged example state exercises the identical program structure a
+    # mid-drain near-quiescent state would.
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+
+    return make_warp_leap(_cfg(), LEAP_K, hybrid=True), (_converged_state(),)
+
+
+def _leap_hybrid_lean():
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+
+    return (
+        make_warp_leap(_cfg(), LEAP_K, hybrid=True),
+        (_lean_state(converged=True),),
+    )
+
+
+def _leap_fleet_masked():
+    # The per-member fleet warp program: the masked hybrid leap (span
+    # length a traced per-member k_m) vmapped over the ensemble axis —
+    # exactly what run_fleet_warped dispatches per leap round.
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+
+    n = TRACE_N // 2
+    fleet = init_fleet(n, TRACE_E, ring_contacts=n - 1, announced=True)
+    leap = jax.vmap(make_warp_leap(_cfg(), LEAP_K, hybrid=True, masked=True))
+    k_m = jnp.full((TRACE_E,), LEAP_K // 2, dtype=jnp.int32)
+    return leap, (fleet.mesh, k_m)
+
+
 def _tick_fleet():
     from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
     from kaboodle_tpu.phasegraph.derive import make_fleet_tick
@@ -352,6 +388,9 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("sim.recorder.telemetry", _recorder_scan_telemetry),
     EntryPoint("phasegraph.leap", _leap),
     EntryPoint("phasegraph.leap.lean", _leap_lean, lean=True),
+    EntryPoint("phasegraph.leap.hybrid", _leap_hybrid),
+    EntryPoint("phasegraph.leap.hybrid.lean", _leap_hybrid_lean, lean=True),
+    EntryPoint("phasegraph.leap.fleet", _leap_fleet_masked),
     EntryPoint("phasegraph.tick.fleet", _tick_fleet),
     EntryPoint("phasegraph.tick.sharded", _tick_sharded, sharded=True),
     EntryPoint("phasegraph.leap.sharded", _leap_sharded, sharded=True),

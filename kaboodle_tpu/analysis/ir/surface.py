@@ -185,14 +185,14 @@ def _prep_warp():
     # The runner memoizes jitted programs process-wide; start this exercise
     # from a cold runner cache so the count is the runner's real surface.
     runner._dense_tick.cache_clear()
-    runner._leap.cache_clear()
     runner._converged.cache_clear()
+    runner.leap_cache.clear()
 
     n = _EX_N
     ticks = 24
     idle = idle_inputs(n, ticks=ticks)
     kill = np.zeros((ticks, n), dtype=bool)
-    kill[8, 1] = True  # one mid-run fault: leap -> dense window -> leap
+    kill[8, 1] = True  # one mid-run fault: leap -> drain window -> leap
     inputs = TickInputs(
         kill=jnp.asarray(kill),
         revive=idle.revive,
@@ -201,27 +201,68 @@ def _prep_warp():
         manual_target=idle.manual_target,
         drop_ok=None,
     )
+    # Warp 2.0 prep: a mid-drain near-quiescent state (two dead peers, every
+    # survivor's cell for them armed) under a drain-shaped config, plus a
+    # two-member fleet (one converged, one mid-drain) for the per-member
+    # warp round. All dense ticking here is eager prep — not counted.
+    import jax
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.fleet.core import FleetState
+    from kaboodle_tpu.phasegraph.derive import make_dense_tick
+    from kaboodle_tpu.sim.scenario import Scenario
+    from kaboodle_tpu.warp.horizon import decode_signature, make_signature_fn
+
+    cfg2 = SwimConfig(deterministic=True, ping_timeout_ticks=64)
+    st2 = init_state(n, seed=3, ring_contacts=n - 1, announced=True)
+    kill1 = jax.tree.map(
+        lambda x: x[0], Scenario(n, 1, seed=0).kill_at(0, [2, 9]).build()
+    )
+    st2, _ = jax.jit(make_dense_tick(cfg2, faulty=True))(st2, kill1)
+    tick0 = jax.jit(make_dense_tick(cfg2, faulty=False))
+    sig = make_signature_fn(cfg2)
+    idle1 = idle_inputs(n)
+    for _ in range(40):
+        if decode_signature(sig(st2)).mode == "hybrid":
+            break
+        st2, _ = tick0(st2, idle1)
+    members = [init_state(n, seed=0, ring_contacts=n - 1, announced=True), st2]
+    fleet = FleetState(
+        mesh=jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *members),
+        drop_rate=jnp.zeros((2,), jnp.float32),
+    )
     return {
         "st": init_state(n, seed=0, ring_contacts=n - 1, announced=True),
         "inputs": inputs,
+        "cfg2": cfg2,
+        "drain": st2,
+        "fleet": fleet,
     }
 
 
 def _run_warp(ctx) -> None:
     """The warp runner over a converged mesh and a sparse-fault schedule:
-    the dense tick + convergence/quiescence checks + the power-of-two
-    leap-chunk programs (plus the runner's own host-side eager helpers —
-    slicing, predicate fetches — which are part of its dispatch surface).
-    Two run lengths whose span decompositions share chunks (48 = 32+16,
-    44 = 32+8+4) prove the power-of-two policy bounds the cache; the
-    regression this guards is one program per distinct span length."""
-    from kaboodle_tpu.warp.runner import run_warped, simulate_warped
+    the dense tick + the signature/convergence programs + the bucketed
+    power-of-two leap-chunk programs, strict AND hybrid (plus the runner's
+    own host-side eager helpers — slicing, metric stacking — which are
+    part of its dispatch surface). Two run lengths whose span
+    decompositions share chunks (48 = 32+16, 44 = 32+8 and 4 dense
+    remainder ticks) prove the bucketing policy bounds the cache; the
+    regression this guards is one program per distinct span length. The
+    post-kill drain drives the hybrid-class program family too."""
+    from kaboodle_tpu.warp.runner import run_fleet_warped, run_warped, simulate_warped
 
     cfg = _cfg()
     st = ctx["st"]
     run_warped(st, cfg, ticks=48, recheck_every=8)
     run_warped(st, cfg, ticks=44, recheck_every=8)
     simulate_warped(st, ctx["inputs"], cfg, faulty=True, recheck_every=8)
+    # Warp 2.0: the hybrid program family over the drain state (chunks
+    # shared with a second length), and one per-member fleet warp round
+    # (vmapped signature + masked fleet leap + masked dense freeze).
+    run_warped(ctx["drain"], ctx["cfg2"], ticks=40, recheck_every=8)
+    run_warped(ctx["drain"], ctx["cfg2"], ticks=24, recheck_every=8)
+    run_fleet_warped(ctx["fleet"], ctx["cfg2"], ticks=16, recheck_every=8)
 
 
 def _prep_fleet():

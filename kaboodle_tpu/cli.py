@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the event-horizon leap engine (kaboodle_tpu.warp) — "
                         "bit-exact with dense ticking, dispatches only the "
                         "eventful/dense ticks")
+    p.add_argument("--no-warp-hybrid", action="store_true",
+                   help="with --warp: disable the Warp 2.0 hybrid "
+                        "(near-quiescent signature class) span programs and "
+                        "fast-forward only strictly quiescent spans — the "
+                        "Warp 1.x behavior knob; the default leaps armed-"
+                        "timer drain windows too (bit-exact either way)")
     p.add_argument("--telemetry", nargs="?", const="telemetry.jsonl",
                    default=None, metavar="PATH",
                    help="sim mode: run the telemetry-plane kernel build "
@@ -300,34 +306,44 @@ def run_sim(args) -> int:
         # --telemetry the leaped spans still contribute counter totals via
         # the closed form (telemetry.counters.leap_counters).
         from kaboodle_tpu.sim.runner import state_converged
-        from kaboodle_tpu.warp.runner import simulate_warped
+        from kaboodle_tpu.warp.runner import WarpLedger, simulate_warped
 
+        hybrid = not args.no_warp_hybrid
+        ledger = WarpLedger()
         t0 = time.perf_counter()
         if telemetry:
             final, dense_ticks, stacked, totals = simulate_warped(
-                state, sc.build(), SwimConfig(), faulty=True, telemetry=True
+                state, sc.build(), SwimConfig(), faulty=True, telemetry=True,
+                hybrid=hybrid, ledger=ledger,
             )
             m = stacked.metrics if stacked is not None else None
             counters = stacked.counters if stacked is not None else None
         else:
             final, dense_ticks, m = simulate_warped(
-                state, sc.build(), SwimConfig(), faulty=True
+                state, sc.build(), SwimConfig(), faulty=True,
+                hybrid=hybrid, ledger=ledger,
             )
             counters = totals = None
         final_conv = bool(state_converged(final))
         wall = time.perf_counter() - t0
+        per_class = ledger.per_class()
         out = {
             "n_peers": sc.n,
             "ticks": sc.ticks,
             "warp": True,
+            "warp_hybrid": hybrid,
             "dense_ticks_executed": int(dense_ticks.size),
             "leaped_ticks": int(sc.ticks - dense_ticks.size),
+            "leap_classes": {
+                str(key): agg for key, agg in sorted(per_class.items())
+            },
             "final_converged": final_conv,
             "wall_s": round(wall, 3),
         }
         if totals is not None:
             out["counter_totals"] = totals
-        _write_sim_manifests(args, out, m, counters, ticks=dense_ticks)
+        _write_sim_manifests(args, out, m, counters, ticks=dense_ticks,
+                             warp_ledger=ledger)
         print(json.dumps(out))
         return 0 if out["final_converged"] else 2
     t0 = time.perf_counter()
@@ -362,12 +378,14 @@ def run_sim(args) -> int:
 
 
 def _write_sim_manifests(args, out, metrics, counters, ticks=None,
-                         recorder=None) -> None:
+                         recorder=None, warp_ledger=None) -> None:
     """The sim lane's manifest outputs (telemetry/manifest.py schema).
 
     ``--telemetry PATH`` gets the full manifest: a ``run`` record (the same
     summary dict the CLI prints), per-tick records with counters, and the
-    flight-recorder dump. ``--metrics-jsonl PATH`` gets metrics-only
+    flight-recorder dump — plus, for warped runs, one ``warp_spans``
+    record per signature class (the per-class leap counters the
+    summarizer aggregates). ``--metrics-jsonl PATH`` gets metrics-only
     ``tick`` records — the lightweight lane that needs no telemetry build.
     Both may be given; they are independent files.
     """
@@ -382,6 +400,9 @@ def _write_sim_manifests(args, out, metrics, counters, ticks=None,
                 w.write_tick_metrics(metrics, counters=counters, ticks=ticks)
             if recorder is not None:
                 w.write_recorder(recorder)
+            if warp_ledger is not None:
+                for key, agg in sorted(warp_ledger.per_class().items()):
+                    w.write("warp_spans", class_key=int(key), **agg)
         print(f"telemetry manifest: {args.telemetry}", file=sys.stderr)
     if args.metrics_jsonl is not None and metrics is not None:
         with ManifestWriter(args.metrics_jsonl) as w:

@@ -169,6 +169,24 @@ def scan_axis_first(inputs: TickInputs) -> TickInputs:
     return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), inputs)
 
 
+def freeze_members(active: jax.Array, old: MeshState, new: MeshState) -> MeshState:
+    """Per-member carry select: advance to ``new`` where ``active[e]``.
+
+    The masked-lockstep freeze: a member with ``active[e] == False`` keeps
+    EVERY leaf of its old carry (state, timers, tick counter, PRNG key), so
+    its trajectory is bit-identical to never having dispatched the tick.
+    Shared by :func:`fleet_converge_loop` (freezing converged members) and
+    the per-member warp runner (freezing members that are mid-leap or done
+    while others still need dense ticks — warp/runner.py).
+    """
+    ensemble = active.shape[0]
+
+    def sel(o, n):
+        return jnp.where(active.reshape((ensemble,) + (1,) * (n.ndim - 1)), n, o)
+
+    return jax.tree.map(sel, old, new)
+
+
 def make_fleet_tick_fn(cfg: SwimConfig, faulty: bool = True, telemetry: bool = False):
     """The phase-graph fleet derivation: the dense tick vmapped over ``[E]``.
 
@@ -236,7 +254,6 @@ def fleet_converge_loop(
     loop, agreement is also tested at entry: a member already converged at
     tick 0 freezes immediately and reports ``conv_tick == 0``.
     """
-    ensemble = mesh.alive.shape[0]
     done0 = jax.vmap(state_converged)(mesh)
 
     def cond(carry):
@@ -248,13 +265,7 @@ def fleet_converge_loop(
         new_st, m = vtick(st, idle)
         # Freeze finished members: their carry (state, timer, tick counter,
         # PRNG key — every leaf) must stop at the convergence tick.
-        st = jax.tree.map(
-            lambda old, new: jnp.where(
-                done.reshape((ensemble,) + (1,) * (new.ndim - 1)), old, new
-            ),
-            st,
-            new_st,
-        )
+        st = freeze_members(~done, st, new_st)
         conv_tick = jnp.where(~done & m.converged, i + 1, conv_tick)
         return st, conv_tick, done | m.converged, i + 1
 

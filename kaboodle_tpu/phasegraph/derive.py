@@ -151,9 +151,18 @@ def make_sharded_tick(
 
 
 def make_warp_leap(
-    cfg: SwimConfig, k: int, constrain: Callable | None = None
+    cfg: SwimConfig,
+    k: int,
+    constrain: Callable | None = None,
+    hybrid: bool = False,
+    masked: bool = False,
 ) -> Callable:
-    """The span program: k quiescent ticks as one batched scan."""
+    """The span / hybrid program: k (near-)quiescent ticks as one scan.
+
+    ``hybrid=True`` derives the Warp 2.0 near-quiescent program (strict
+    span + sterile anti-entropy — ``plan(graph, "hybrid")``);
+    ``masked=True`` makes the span length a traced ``k_m <= k`` so the
+    fleet runner can vmap one program over per-member horizons."""
     from kaboodle_tpu.phasegraph.span import make_leap_fn
 
-    return make_leap_fn(cfg, k, constrain=constrain)
+    return make_leap_fn(cfg, k, constrain=constrain, hybrid=hybrid, masked=masked)

@@ -47,6 +47,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     import jax
+    import jax.numpy as jnp
 
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
@@ -116,6 +117,17 @@ def main(argv=None) -> int:
     for _ in range(k):
         st_k, _ = step(st_k, idle)
     results["warp"] = _state_equal(st_w, st_k)
+
+    # hybrid: the Warp 2.0 near-quiescent span program. On a strictly
+    # quiescent mesh it must degenerate bit-exactly to the same k dense
+    # ticks (no anti-entropy candidate ever matches); the masked build at
+    # a traced k_m == k must agree too, and k_m == 0 must be the identity.
+    hybrid = make_warp_leap(cfg, k, hybrid=True)
+    results["hybrid"] = _state_equal(jax.jit(hybrid)(conv), st_k)
+    masked = jax.jit(make_warp_leap(cfg, k, hybrid=True, masked=True))
+    results["hybrid_masked"] = _state_equal(
+        masked(conv, jnp.int32(k)), st_k
+    ) and _state_equal(masked(conv, jnp.int32(0)), conv)
 
     ok = all(results.values())
     for name, good in results.items():

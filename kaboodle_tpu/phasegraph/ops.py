@@ -19,7 +19,14 @@ sim/kernel.py's docstring), declaring
   ``degenerate`` (collapses to the timer-restamp / latency-decay /
   ledger-fixed-point form the leap batches), or ``invariant`` (provably a
   no-op — horizon.py's quiescence predicate is exactly the conjunction of
-  these invariance conditions).
+  these invariance conditions),
+- its **hybrid** fate inside a *near*-quiescent span (Warp 2.0): ``live``
+  (runs as in the strict span), ``sterile`` (runs in a membership-moving-
+  free closed form — anti-entropy traffic whose marks refresh timers and
+  rewrite the ledger but provably insert nothing), or ``invariant``
+  (excluded by the activity signature — ``sig_term`` names the signature
+  bit whose truth would make the op active, so warp/horizon.py's
+  signature vocabulary is derived from the ops, not hand-listed twice).
 
 This module is pure metadata — no jax imports — so the planner and its
 tests run at AST-adjacent cost. The executable bodies live in exec.py
@@ -68,6 +75,8 @@ class PhaseOp:
     pred_term: str | None = None  # dispatch-pred symbol that excludes it
     mask_rank: int = 1
     span: str = "invariant"  # "live" | "degenerate" | "invariant"
+    hybrid: str = "invariant"  # "live" | "sterile" | "invariant"
+    sig_term: str | None = None  # activity-signature bit that excludes it
     cut: str | None = None
 
     def __post_init__(self) -> None:
@@ -75,6 +84,8 @@ class PhaseOp:
             raise ValueError(f"{self.name}: bad stage {self.stage!r}")
         if self.span not in ("live", "degenerate", "invariant"):
             raise ValueError(f"{self.name}: bad span fate {self.span!r}")
+        if self.hybrid not in ("live", "sterile", "invariant"):
+            raise ValueError(f"{self.name}: bad hybrid fate {self.hybrid!r}")
         if self.mask_rank not in (1, 2):
             raise ValueError(f"{self.name}: bad mask_rank {self.mask_rank!r}")
         unknown = (self.reads | self.writes) - set(FIELDS)
@@ -87,13 +98,18 @@ class PhaseOp:
 
 def _op(name, phase, doc, stage, *, reads=(), writes=(), inputs=(), gives=(),
         takes=(), activity="always", pred_term=None, mask_rank=1,
-        span="invariant", cut=None) -> PhaseOp:
+        span="invariant", hybrid=None, sig_term=None, cut=None) -> PhaseOp:
+    if hybrid is None:
+        # Default: whatever still runs in a strict span also runs in the
+        # hybrid one; strict-invariant ops stay excluded unless declared.
+        hybrid = "live" if span in ("live", "degenerate") else "invariant"
     return PhaseOp(
         name=name, phase=phase, doc=doc, stage=stage,
         reads=frozenset(reads), writes=frozenset(writes),
         inputs=frozenset(inputs), gives=frozenset(gives),
         takes=frozenset(takes), activity=activity, pred_term=pred_term,
-        mask_rank=mask_rank, span=span, cut=cut,
+        mask_rank=mask_rank, span=span, hybrid=hybrid, sig_term=sig_term,
+        cut=cut,
     )
 
 
@@ -156,7 +172,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             reads=("alive", "never_b", "last_b", "tick"),
             writes=("never_b", "last_b"),
             takes=("row_count0",), gives=("join_b", "any_join"),
-            activity="a Join broadcast fires this tick",
+            activity="a Join broadcast fires this tick", sig_term="any_join",
         ))
     ops.append(_op(
         "manual_targets", "A4",
@@ -177,7 +193,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         takes=("keys", "has_timed", "wfip_any"),
         gives=("escalate", "insta_remove", "jstar", "proxies", "any_rem"),
         activity="any_a2: a timed-out suspicion exists", pred_term="any_a2",
-        mask_rank=2, span="invariant",
+        mask_rank=2, span="invariant", sig_term="any_a2",
     ))
     ops.append(_op(
         "probe_draw", "A3",
@@ -196,7 +212,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             writes=("S", "T", "idv"),
             takes=("join_b", "any_join", "ok"), gives=("Jm", "is_new_ro"),
             activity="any_join", pred_term="any_join", mask_rank=2,
-            span="invariant",
+            span="invariant", sig_term="any_join",
         ))
     if not cfg.faithful_failed_broadcast:
         ops.append(_op(
@@ -208,6 +224,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             takes=("ok", "any_rem") + (("Jm",) if cfg.join_broadcast_enabled else ()),
             activity="any_rem: a removal happened this tick",
             pred_term="any_a2", mask_rank=2, span="invariant",
+            sig_term="any_a2",
         ))
     if cfg.join_broadcast_enabled:
         ops.append(_op(
@@ -219,7 +236,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             takes=("keys", "ok", "Jm", "is_new_ro", "row_count0", "any_join"),
             gives=("reply_del", "gossip", "join_records"),
             activity="any_join", pred_term="any_join", mask_rank=2,
-            span="invariant",
+            span="invariant", sig_term="any_join",
         ))
     ops.append(_op(
         "call1", "1",
@@ -256,7 +273,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
                "del_ack", "del_ack_man", "ping_tgt", "man_tgt", "ok"),
         gives=("del_pack", "fwd", "fwd_c", "del_fwd", "del_fwd_c"),
         activity="any escalation this tick", pred_term="any_a2",
-        mask_rank=2, span="invariant", cut="c34",
+        mask_rank=2, span="invariant", sig_term="any_a2", cut="c34",
     ))
     ops.append(_op(
         "anti_entropy", "G",
@@ -275,7 +292,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         gives=("partner", "del_kpr", "del_rep", "fp_g", "n_g", "fp_f",
                "n_f", "ae_records"),
         activity="fingerprints disagree somewhere", span="degenerate",
-        cut="G",
+        hybrid="sterile", cut="G",
     ))
     if telemetry:
         ops.append(_op(

@@ -21,6 +21,16 @@ executes:
   predicate conjoins exactly these invariance conditions), ``degenerate``
   ops collapse to the timer-restamp / latency-decay / ledger-fixed-point
   forms, and the leap batches the k surviving draws as one scan.
+- ``hybrid`` — the Warp 2.0 near-quiescent derivation: ops whose hybrid
+  fate is ``invariant`` are pruned and their ``sig_term`` declarations
+  flow into ``pred_terms`` — the activity-signature bits
+  (warp/horizon.py) that must be clear for the hybrid span to be exact.
+  ``sterile`` ops (anti-entropy) survive in a membership-moving-free
+  closed form: timer marks + the kpr ledger carry, provably zero inserts
+  under the signature's sterility bits. Everything a strict span runs,
+  the hybrid span runs too — a strictly-quiescent state is just the
+  hybrid class with no armed timers and agreed fingerprints, so the
+  hybrid program is a strict superset that degenerates bit-exactly.
 - ``blocked`` — the chunked derivation: the full pass order, each [N, N]
   pass re-expressed as a ``lax.map`` over row blocks (layout, not logic).
 
@@ -37,7 +47,7 @@ import dataclasses
 from kaboodle_tpu.phasegraph.graph import GraphError, TickGraph
 from kaboodle_tpu.phasegraph.ops import PhaseOp
 
-MODES = ("full", "fused", "span", "blocked")
+MODES = ("full", "fused", "span", "blocked", "hybrid")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,6 +188,52 @@ def _plan_span(graph: TickGraph) -> TickProgram:
     )
 
 
+def _plan_hybrid(graph: TickGraph) -> TickProgram:
+    if graph.faulty:
+        raise GraphError(
+            "hybrid programs derive from fault-free graphs: a near-quiescent "
+            "span carries no scheduled events by definition (horizon.py)"
+        )
+    pruned: list[tuple[str, str]] = []
+    pred: list[str] = []
+    live: list[PhaseOp] = []
+    refresh: list[PhaseOp] = []
+    sterile: list[PhaseOp] = []
+    ledger: list[PhaseOp] = []
+    for op in graph.ops:
+        if op.hybrid == "invariant":
+            why = (
+                f"excluded by signature bit ({op.sig_term})"
+                if op.sig_term is not None
+                else "span fixed point (signature sterility terms)"
+            )
+            pruned.append((op.name, why))
+            if op.sig_term is not None and op.sig_term not in pred:
+                pred.append(op.sig_term)
+        elif op.hybrid == "sterile":
+            sterile.append(op)
+        elif op.name in ("call1", "call2"):
+            refresh.append(op)
+        elif op.span == "live":
+            live.append(op)
+        else:
+            # finish (and counters in telemetry graphs) degenerate to
+            # once-per-span closed forms, as in the strict span plan.
+            ledger.append(op)
+    return TickProgram(
+        mode="hybrid",
+        prologue=(),
+        tail=(
+            Pass("draw", tuple(live)),
+            Pass("refresh", tuple(refresh)),
+            Pass("ae", tuple(sterile)),
+            Pass("ledger", tuple(ledger)),
+        ),
+        pruned=tuple(pruned),
+        pred_terms=tuple(pred),
+    )
+
+
 def _plan_blocked(graph: TickGraph) -> TickProgram:
     full = _plan_full(graph)
     return dataclasses.replace(full, mode="blocked")
@@ -192,4 +248,5 @@ def plan(graph: TickGraph, mode: str) -> TickProgram:
         "fused": _plan_fused,
         "span": _plan_span,
         "blocked": _plan_blocked,
+        "hybrid": _plan_hybrid,
     }[mode](graph)

@@ -83,6 +83,18 @@ _SPAN_PASSES = {
     "ledger": ("anti_entropy", "finish"),
 }
 
+# The hybrid (near-quiescent) program's pass sets — Warp 2.0. The sterile
+# anti-entropy pass is the one addition over the strict span: under the
+# activity signature's sterility bits (warp/horizon.py) every KPR exchange
+# provably inserts nothing, so its whole effect is two timer marks per
+# delivered request plus the kpr ledger rewrite — both modeled exactly.
+_HYBRID_PASSES = {
+    "draw": ("rng_split", "probe_draw"),
+    "refresh": ("call1", "call2"),
+    "ae": ("anti_entropy",),
+    "ledger": ("finish",),
+}
+
 # Segment width: columns per (row, block) summary segment. The per-tick cost
 # is ~O(N·W) for the touched-segment re-reduction plus O(N·5·ceil(N/W)) for
 # the cross-segment selection, so W ~ sqrt-ish of N balances the two; 64
@@ -128,33 +140,62 @@ def make_leap_fn(
     cfg: SwimConfig,
     k: int,
     constrain: Callable[[jax.Array], jax.Array] | None = None,
-) -> Callable[[MeshState], MeshState]:
+    hybrid: bool = False,
+    masked: bool = False,
+) -> Callable:
     """Build the jittable k-tick leap for a given protocol config.
 
     ``k`` is static (the span length folds into the compiled program — the
-    warp runner caches one program per distinct span length). ``constrain``
-    is the sharding hook: applied to every scan carry each step, it keeps
-    the GSPMD layout stable under the scan, like
-    ``parallel.make_sharded_tick``'s per-tick constraint (the runner passes
-    a row-axis pin built from ``parallel.row_matrix_sharding``).
+    warp runner's bounded cache holds one program per power-of-two bucket,
+    never one per distinct span length). ``constrain`` is the sharding
+    hook: applied to every scan carry each step, it keeps the GSPMD layout
+    stable under the scan, like ``parallel.make_sharded_tick``'s per-tick
+    constraint (the runner passes a row-axis pin built from
+    ``parallel.row_matrix_sharding``).
 
-    Precondition (checked by the caller via horizon.make_quiescence_fn,
-    never re-checked here): the state is quiescent and the next ``k`` ticks
-    carry no scheduled events. Under that precondition the result is
-    bit-equal to ``k`` dense fault-free ticks (tests/test_warp.py pins it
-    per state variant; tests/test_fuzz_parity.py fuzzes it through whole
-    schedules).
+    ``hybrid=True`` builds the Warp 2.0 **near-quiescent** program
+    (``plan(graph, "hybrid")``): the strict span's draw/refresh machinery
+    plus the sterile anti-entropy pass — per tick, the fused dense tick's
+    phase-0/1 KnownPeersRequest partner selection (O(N) scatter-min, exact
+    arrival-order priority), the request/reply timer marks folded into the
+    same segmented scatter the ping marks use, and the kpr ledger carried
+    through the scan. Under the activity signature's sterility bits
+    (warp/horizon.py: no timer may expire in-span, no join owed, no
+    waiting-on-alive cell, no Known-dead cell, no missing-alive member, no
+    stale identity view) every AE reply share is a subset of the
+    requester's membership, so fingerprints and membership are span
+    invariants and the program is bit-equal to k dense fused ticks. A
+    strictly-quiescent entry state is the degenerate case (no candidate
+    ever matches), so the hybrid program is a strict superset of the span
+    program, bit-for-bit.
+
+    ``masked=True`` returns ``leap(st, k_m)`` with a *traced* span length
+    ``k_m <= k``: steps beyond ``k_m`` are select-masked no-ops (key chain,
+    timer scatters, ledger and tick counter all freeze), which is what
+    lets the fleet runner vmap ONE compiled program over members leaping
+    to their own per-member horizons. ``k_m == 0`` returns the state
+    bit-unchanged.
+
+    Precondition (checked by the caller via horizon signatures, never
+    re-checked here): the state is in the program's signature class and
+    the next ``k`` (or ``k_m``) ticks carry no scheduled events and end
+    strictly before the earliest timer expiry. Under that precondition the
+    result is bit-equal to the dense fault-free ticks (tests/test_warp.py
+    pins it per state variant; tests/test_fuzz_parity.py fuzzes it through
+    whole schedules).
     """
     if k < 1:
         raise ValueError("need k >= 1")
-    # Derive the span program from the op graph and pin it to what this
-    # module implements: every op outside these passes must have been
-    # pruned by the planner as a span fixed point.
-    prog = plan(build_graph(cfg, faulty=False), "span")
+    # Derive the program from the op graph and pin it to what this module
+    # implements: every op outside these passes must have been pruned by
+    # the planner (as a span fixed point / by a signature bit).
+    mode = "hybrid" if hybrid else "span"
+    want = _HYBRID_PASSES if hybrid else _SPAN_PASSES
+    prog = plan(build_graph(cfg, faulty=False), mode)
     got = {p.name: p.op_names for p in prog.tail}
-    if got != _SPAN_PASSES:
+    if got != want:
         raise NotImplementedError(
-            f"span plan {got} != leap implementation {_SPAN_PASSES}"
+            f"{mode} plan {got} != leap implementation {want}"
         )
     det = cfg.deterministic
     kk = cfg.num_candidate_target_peers
@@ -165,7 +206,7 @@ def make_leap_fn(
     # Named scope: labels the leap's ops in jax.profiler captures (metadata
     # only — numerics and compiled-program identity are unchanged).
     @jax.named_scope("kaboodle:leap")
-    def leap(st: MeshState) -> MeshState:  # graftlint: traced
+    def leap_impl(st: MeshState, k_m) -> MeshState:  # graftlint: traced
         n = st.state.shape[-1]
         n_cand = min(kk, n)
         W = min(_SEG_W, n)
@@ -179,10 +220,12 @@ def make_leap_fn(
         tmin = jnp.asarray(jnp.iinfo(T.dtype).min, dtype=T.dtype)
 
         # The eligibility mask is a span invariant (membership and aliveness
-        # are fixed points), so the masked scores — exactly what the dense
-        # draw ranks — can be the carry; every in-span write lands on an
-        # eligible cell (both endpoints alive and mutually Known). Padded to
-        # the segment grid with ineligible sentinel columns.
+        # are fixed points — in the hybrid class too: marks land only on
+        # Known-of-alive cells, and sterile AE inserts nothing), so the
+        # masked scores — exactly what the dense draw ranks — can be the
+        # carry; every in-span write lands on an eligible cell (both
+        # endpoints alive and mutually Known). Padded to the segment grid
+        # with ineligible sentinel columns.
         elig = alive[:, None] & (S == KNOWN) & ~eye
         scores0 = jnp.pad(
             jnp.where(elig, T, tmax), ((0, 0), (0, pad)), constant_values=tmax
@@ -196,33 +239,63 @@ def make_leap_fn(
             scores0.reshape(n, B, W), cols.reshape(n, B, W), n_cand, tmax
         )  # [n, B, n_cand]
 
-        # ---- the [k, ...] draw batch (counter-based PRNG) -----------------
-        # Key chain: the dense tick derives (proxy, ping, bern, drop, next)
-        # from split(key, 5) and carries row 4; only the ping key is ever
-        # consumed on a quiescent tick.
-        def key_step(key, _):
-            ks = jax.random.split(key, 5)
-            return ks[4], ks[1]
-
-        key_final, ping_keys = jax.lax.scan(key_step, st.key, None, length=k)
-        ticks = st.tick + jnp.arange(k, dtype=jnp.int32)  # [k] in-span tick values
-        if det:
-            xs = (ticks, jnp.zeros((k, 1), dtype=jnp.float32))  # u unused
-        else:
-            # dtype pinned f32 (KB401): must match the dense kernel's
-            # pick_candidate uniforms bit-for-bit under any x64 flag state.
-            xs = (
-                ticks,
-                jax.vmap(
-                    lambda kp: jax.random.uniform(kp, (n,), dtype=jnp.float32)
-                )(ping_keys),
+        if hybrid:
+            # Span invariants the sterile AE pass ranks against: per-row
+            # fingerprint and map size (S, idv are fixed points in-class).
+            member = S > 0
+            fp = membership_fingerprint(
+                member, st.id_view if st.id_view is not None else st.identity
             )
+            n_row = jnp.sum(member, axis=-1, dtype=jnp.int32)
+        INF = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+        if not masked:
+            # ---- the [k, ...] draw batch (counter-based PRNG) -------------
+            # Key chain: the dense tick derives (proxy, ping, bern, drop,
+            # next) from split(key, 5) and carries row 4; only the ping key
+            # is ever consumed on a quiescent tick.
+            def key_step(key, _):
+                ks = jax.random.split(key, 5)
+                return ks[4], ks[1]
+
+            key_final, ping_keys = jax.lax.scan(key_step, st.key, None, length=k)
+            ticks = st.tick + jnp.arange(k, dtype=jnp.int32)  # [k] in-span ticks
+            if det:
+                xs = (ticks, jnp.zeros((k, 1), dtype=jnp.float32))  # u unused
+            else:
+                # dtype pinned f32 (KB401): must match the dense kernel's
+                # pick_candidate uniforms bit-for-bit under any x64 flag.
+                xs = (
+                    ticks,
+                    jax.vmap(
+                        lambda kp: jax.random.uniform(kp, (n,), dtype=jnp.float32)
+                    )(ping_keys),
+                )
+        else:
+            # Masked mode: the key chain must advance exactly k_m times, so
+            # it rides the carry and splits under the step mask.
+            xs = jnp.arange(k, dtype=jnp.int32)
 
         seg = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W] within-segment
 
         def body(carry, x):
-            scores, sum_t, sum_c, lat = carry
-            t, u_t = x
+            if hybrid:
+                scores, sum_t, sum_c, lat, key, kprp, kprf, kprn = carry
+            else:
+                scores, sum_t, sum_c, lat, key = carry
+            if masked:
+                step = x
+                active = step < k_m
+                ks = jax.random.split(key, 5)
+                key = jnp.where(active, ks[4], key)
+                t = st.tick + step
+                u_t = (
+                    None if det
+                    else jax.random.uniform(ks[1], (n,), dtype=jnp.float32)
+                )
+            else:
+                t, u_t = x
+                active = None
             tT = t.astype(scores.dtype)
 
             # Cross-segment selection: the global oldest-5 of a row is among
@@ -241,23 +314,72 @@ def make_leap_fn(
                 jnp.minimum(cand_idx, n - 1), cand_valid, u_sel
             )
             has_ping = tgt >= 0  # False exactly on dead/empty rows
+            if masked:
+                has_ping &= active
             tgtc = jnp.clip(tgt, 0)
 
-            # Cumulative timer effect of the tick's surviving traffic: the
-            # A3 stamp + ack re-stamp at (i, tgt_i) and the Q1 mark at
-            # (tgt_i, i), all writing the same tick value — a scatter-max
-            # with a dtype-min no-op sentinel masks out pingless rows, and
-            # duplicate edges (mutual pings) collide on equal values.
-            rows_u = jnp.concatenate([idx, tgtc])
-            cols_u = jnp.concatenate([tgtc, idx])
-            val = jnp.where(jnp.concatenate([has_ping, has_ping]), tT, tmin)
+            if hybrid:
+                # Sterile anti-entropy: the fused dense tick's phase-0/1
+                # candidate selection (exec.py _ae_phase01) against the
+                # span-invariant (fp, n_row). Phase 0 — last tick's KPR
+                # senders, priority = sender index — as an O(N) scatter-min
+                # instead of the dense [N, N] compare (same minimum, bit
+                # exact); phase 1 — this tick's delivered acks, priority =
+                # n + target. Sender aliveness is NOT checked in phase 0
+                # (matching the dense kernel: a dead sender's stale request
+                # can win the priority and then fail delivery).
+                ppc = jnp.clip(kprp, 0)
+                cand0 = (kprp >= 0) & (kprf != fp[ppc]) & (n_row[ppc] <= kprn)
+                prio0 = (
+                    jnp.full((n,), INF, jnp.int32)
+                    .at[ppc]
+                    .min(jnp.where(cand0, idx, INF))
+                )
+                prio0 = jnp.where(alive, prio0, INF)
+                # Phase 1: in-class every ping is delivered and acked
+                # (targets are Known => alive), so del_ack == has_ping.
+                tc = jnp.clip(tgt, 0)
+                cand1 = has_ping & (fp[tc] != fp) & (n_row <= n_row[tc])
+                prio1 = jnp.where(cand1, jnp.int32(n) + tgt, INF)
+                best = jnp.minimum(prio0, prio1)
+                partner = jnp.where(best == prio0, prio0, tgt).astype(jnp.int32)
+                has_req = (best != INF) & alive
+                partner = jnp.where(has_req, partner, -1)
+                pc2 = jnp.clip(partner, 0)
+                del_kpr = has_req & alive[pc2]
+                if masked:
+                    del_kpr &= active
+
+                # Timer effect: ping A3 stamp + ack re-stamp at (i, tgt_i),
+                # Q1 mark at (tgt_i, i), AE request mark at (partner_i, i)
+                # and reply mark at (i, partner_i) — all writing this tick's
+                # value, so one scatter-max with a dtype-min no-op sentinel
+                # covers all four edge families.
+                rows_u = jnp.concatenate([idx, tgtc, pc2, idx])
+                cols_u = jnp.concatenate([tgtc, idx, idx, pc2])
+                val = jnp.where(
+                    jnp.concatenate([has_ping, has_ping, del_kpr, del_kpr]),
+                    tT,
+                    tmin,
+                )
+            else:
+                # Cumulative timer effect of the tick's surviving traffic:
+                # the A3 stamp + ack re-stamp at (i, tgt_i) and the Q1 mark
+                # at (tgt_i, i), all writing the same tick value — a
+                # scatter-max with a dtype-min no-op sentinel masks out
+                # pingless rows, and duplicate edges (mutual pings) collide
+                # on equal values.
+                rows_u = jnp.concatenate([idx, tgtc])
+                cols_u = jnp.concatenate([tgtc, idx])
+                val = jnp.where(jnp.concatenate([has_ping, has_ping]), tT, tmin)
             scores = pin(scores.at[rows_u, cols_u].max(val))
 
-            # Touched segments — (i, seg(tgt_i)) and (tgt_i, seg(i)) — are
-            # re-reduced from the updated scores and scattered back; every
-            # other segment's summary is untouched by construction.
+            # Touched segments are re-reduced from the updated scores and
+            # scattered back; every other segment's summary is untouched by
+            # construction (an untouched listed segment rewrites its own
+            # values — the masked/no-ping entries degenerate to that).
             blocks_u = cols_u // W
-            seg_cols = blocks_u[:, None] * W + seg  # [2N, W] global cols
+            seg_cols = blocks_u[:, None] * W + seg  # [2N or 4N, W] global cols
             seg_t = scores[rows_u[:, None], seg_cols]
             new_t, new_c, _ = _lex_k_smallest(seg_t, seg_cols, n_cand, tmax)
             sum_t = pin(sum_t.at[rows_u, blocks_u].set(new_t))
@@ -265,34 +387,73 @@ def make_leap_fn(
 
             if has_lat:
                 # One zero-tick EWMA sample per pinged edge (module
-                # docstring): NaN -> 0.0 first sample, else 0.2 * old.
+                # docstring): NaN -> 0.0 first sample, else 0.2 * old. AE
+                # marks never sample (their cells are not in a waiting
+                # state in-class), so the hybrid adds nothing here.
                 cur = lat[idx, tgtc]
                 upd = jnp.where(
                     jnp.isnan(cur), jnp.float32(0.0), jnp.float32(0.2) * cur
                 )
                 lat = pin(lat.at[idx, tgtc].set(jnp.where(has_ping, upd, cur)))
-            return (scores, sum_t, sum_c, lat), None
 
-        carry0 = (pin(scores0), pin(sum_t0), pin(sum_c0), lat)
-        (scores_k, _, _, lat_k), _ = jax.lax.scan(body, carry0, xs)
+            if hybrid:
+                # The kpr ledger the dense _finish writes every tick:
+                # partner where the request delivered, the (invariant)
+                # fingerprint and map size. Frozen on masked-out steps.
+                led_p = jnp.where(del_kpr, partner, -1)
+                if masked:
+                    kprp = jnp.where(active, led_p, kprp)
+                    kprf = jnp.where(active, fp, kprf)
+                    kprn = jnp.where(active, n_row, kprn)
+                else:
+                    kprp, kprf, kprn = led_p, fp, n_row
+                return (scores, sum_t, sum_c, lat, key, kprp, kprf, kprn), None
+            return (scores, sum_t, sum_c, lat, key), None
 
-        # Anti-entropy ledger at the span's final tick (fixed point, written
-        # once): no request in flight, fingerprint + map size per row.
-        fp = membership_fingerprint(
-            S > 0, st.id_view if st.id_view is not None else st.identity
-        )
-        n_row = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
+        key0 = st.key  # advanced in the carry only in masked mode
+        if hybrid:
+            carry0 = (
+                pin(scores0), pin(sum_t0), pin(sum_c0), lat, key0,
+                st.kpr_partner, st.kpr_fp, st.kpr_n,
+            )
+            (scores_k, _, _, lat_k, key_k, kprp_k, kprf_k, kprn_k), _ = (
+                jax.lax.scan(body, carry0, xs)
+            )
+        else:
+            carry0 = (pin(scores0), pin(sum_t0), pin(sum_c0), lat, key0)
+            (scores_k, _, _, lat_k, key_k), _ = jax.lax.scan(body, carry0, xs)
+            # Anti-entropy ledger at the span's final tick (fixed point,
+            # written once): no request in flight, fingerprint + map size.
+            fp = membership_fingerprint(
+                S > 0, st.id_view if st.id_view is not None else st.identity
+            )
+            n_row = jnp.sum(S > 0, axis=-1, dtype=jnp.int32)
+            kprp_k = jnp.full((n,), -1, dtype=jnp.int32)
+            kprf_k, kprn_k = fp, n_row
+            if masked:
+                # k_m == 0 must leave the ledger untouched too.
+                ran = k_m > 0
+                kprp_k = jnp.where(ran, kprp_k, st.kpr_partner)
+                kprf_k = jnp.where(ran, kprf_k, st.kpr_fp)
+                kprn_k = jnp.where(ran, kprn_k, st.kpr_n)
 
         return dataclasses.replace(
             st,
             timer=jnp.where(elig, scores_k[:, :n], T),
             latency=lat_k,
-            tick=st.tick + k,
-            key=key_final,
-            kpr_partner=jnp.full((n,), -1, dtype=jnp.int32),
-            kpr_fp=fp,
-            kpr_n=n_row,
+            tick=st.tick + (k_m if masked else k),
+            key=key_k if masked else key_final,
+            kpr_partner=kprp_k,
+            kpr_fp=kprf_k,
+            kpr_n=kprn_k,
         )
+
+    if masked:
+        def leap(st: MeshState, k_m) -> MeshState:  # graftlint: traced
+            return leap_impl(st, jnp.asarray(k_m, jnp.int32))
+    else:
+        def leap(st: MeshState) -> MeshState:  # graftlint: traced
+            return leap_impl(st, None)
 
     # Program metadata for derived consumers (trace slices, registry, dryrun).
     leap.program = prog

@@ -143,8 +143,13 @@ def warp_stats(dense_ticks, metrics: TickMetrics | None) -> np.ndarray:
 
     ``(dense_ticks, metrics)`` come from
     :func:`kaboodle_tpu.warp.runner.simulate_warped`: only the densely
-    executed ticks carry metrics (leaped spans are provably converged and
-    quiet, so their rows would be constant). The returned table is
+    executed ticks carry metrics. Strict-leaped spans are provably
+    converged and quiet (their rows would be constant); HYBRID-leaped
+    spans (Warp 2.0 near-quiescent drain windows) are NOT converged —
+    armed timers and disagreeing fingerprints persist through them — so a
+    gap between rows means only "nothing the span programs cannot model
+    happened", not "all quiet": consult the run's ``WarpLedger`` per-class
+    records for what each gap was. The returned table is
     :func:`tick_stats`' layout with the ``tick`` column rewritten to the
     actual tick indices — gaps between consecutive rows are exactly the
     leaped spans. ``None`` metrics (everything leaped) gives an empty table.

@@ -69,6 +69,7 @@ def summarize(records: dict[str, list[dict]]) -> dict:
     kinds: dict[str, int] = {}
     runs: list[dict] = []
     ticks: list[dict] = []
+    warp_spans: list[dict] = []
     for recs in records.values():
         for rec in recs:
             kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
@@ -76,6 +77,8 @@ def summarize(records: dict[str, list[dict]]) -> dict:
                 runs.append(rec)
             elif rec["kind"] == "tick":
                 ticks.append(rec)
+            elif rec["kind"] == "warp_spans":
+                warp_spans.append(rec)
     out: dict = {
         "metric": "telemetry_manifest_summary",
         "manifests": len(records),
@@ -87,6 +90,20 @@ def summarize(records: dict[str, list[dict]]) -> dict:
             for r in runs
         ],
     }
+    if warp_spans:
+        # Warp 2.0 per-class leap counters: one row per signature class
+        # (strict / hybrid / fleet), aggregated across manifests.
+        classes: dict = {}
+        for rec in warp_spans:
+            agg = classes.setdefault(
+                int(rec["class_key"]),
+                {"engine": rec.get("engine", ""),
+                 "terms": rec.get("terms", []),
+                 "spans": 0, "ticks": 0, "dispatches": 0},
+            )
+            for f in ("spans", "ticks", "dispatches"):
+                agg[f] += int(rec.get(f, 0))
+        out["leap_classes"] = {str(k): v for k, v in sorted(classes.items())}
     if ticks:
         ticks.sort(key=lambda r: r["tick"])
         totals = {

@@ -1,34 +1,55 @@
-"""Event-horizon fast-forward: advance quiescent tick spans in one pass.
+"""Signature-classed event-horizon fast-forward (Warp 2.0).
 
-The first subsystem that changes *how many* kernels run rather than how fast
-each one is: horizon.py statically + on-device identifies spans where nothing
-protocol-relevant can happen, leap.py replays k such ticks as one batched
-program (bit-exact with the dense kernel), runner.py interleaves leaps with
-dense ticks behind the same contracts as sim/runner.py — single-device,
-sharded (GSPMD), and fleet (per-member horizon mask) alike.
+The subsystem that changes *how many* kernels run rather than how fast each
+one is: horizon.py statically identifies event-free spans and classes each
+span's entry state by an on-device **activity signature** (one int32[4]
+fetch: term bits + active-row bucket + earliest timer expiry); leap.py /
+phasegraph/span.py replay a span as one batched program — the strict span
+program under full quiescence, the HYBRID near-quiescent program (strict
+leap + sterile anti-entropy) on armed-timer drain windows up to the
+earliest expiry — bit-exact with the dense kernel either way; runner.py
+interleaves leaps with dense ticks behind the same contracts as
+sim/runner.py — single-device, sharded (GSPMD), and fleet (per-member
+horizons: every member leaps to its own next event inside one masked
+vmapped dispatch) alike, memoizing compiled span programs in an explicitly
+bounded cache.
 """
 
 from kaboodle_tpu.warp.horizon import (
+    ActivityClass,
+    decode_signature,
+    earliest_timer_expiry,
     make_expiry_fn,
     make_quiescence_fn,
+    make_signature_fn,
     next_static_event,
     static_event_ticks,
 )
 from kaboodle_tpu.warp.leap import make_leap_fn
 from kaboodle_tpu.warp.runner import (
+    WarpLedger,
     fleet_quiescence_mask,
+    fleet_signature,
+    leap_cache,
     run_fleet_warped,
     run_warped,
     simulate_warped,
 )
 
 __all__ = [
+    "ActivityClass",
+    "decode_signature",
+    "earliest_timer_expiry",
     "make_expiry_fn",
     "make_quiescence_fn",
+    "make_signature_fn",
     "next_static_event",
     "static_event_ticks",
     "make_leap_fn",
+    "WarpLedger",
     "fleet_quiescence_mask",
+    "fleet_signature",
+    "leap_cache",
     "run_fleet_warped",
     "run_warped",
     "simulate_warped",

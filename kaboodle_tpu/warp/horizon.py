@@ -35,6 +35,7 @@ holding no delivery can move membership or identity words.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -175,3 +176,207 @@ def make_expiry_fn(cfg: SwimConfig):
         return jnp.min(jnp.where(waiting, deadline, _I32MAX))
 
     return jax.jit(expiry)
+
+
+def earliest_timer_expiry(st: MeshState, cfg: SwimConfig) -> int:
+    """Host convenience: the earliest tick at which phase A2 could fire.
+
+    ``min`` over alive rows' waiting cells of ``timer + ping_timeout_ticks``
+    — the first tick whose dense execution can escalate or remove an entry.
+    ``INT32_MAX`` when no timer is armed. This is the suspicion source of
+    the event horizon: a hybrid span starting at tick t may cover exactly
+    the ticks in ``[t, earliest_timer_expiry)`` (strictly before — the
+    expiry tick itself must run dense)."""
+    return int(make_expiry_fn(cfg)(st))
+
+
+# ---------------------------------------------------------------------------
+# Warp 2.0: the activity signature (signature-classed fast-forward)
+
+# Signature term bits. The first two are the phase-op activity terms the
+# hybrid planner derives from the op graph (``plan(graph, "hybrid")``'s
+# ``pred_terms`` — ops.py ``sig_term`` declarations); the rest are the
+# state-borne sterility terms the hybrid span program additionally needs.
+# ``make_signature_fn`` asserts the planner's terms stay inside this
+# vocabulary, so a new rare-phase op with a fresh sig_term fails loudly
+# here instead of silently leaping past its activity.
+SIG_ANY_A2 = 1 << 0       # a suspicion timer has ALREADY matured (A2 fires now)
+SIG_ANY_JOIN = 1 << 1     # a Join broadcast is owed (never_broadcast / lonely)
+SIG_ARMED = 1 << 2        # waiting cells exist in alive rows (timers armed)
+SIG_WAIT_ALIVE = 1 << 3   # some waiting cell targets an ALIVE peer (refutable)
+SIG_KNOWN_DEAD = 1 << 4   # some alive row still Knows a dead peer (unacked ping)
+SIG_MISSING = 1 << 5      # some alive row is missing an alive peer (AE inserts)
+SIG_FP_DISAGREE = 1 << 6  # fingerprints disagree over alive rows (AE traffic)
+SIG_IDENT_STALE = 1 << 7  # an identity view lags the sender's current word
+SIG_KPR_LIVE = 1 << 8     # a carried KnownPeersRequest could fire (phase 0)
+SIG_TOO_FEW = 1 << 9      # n_alive < 2
+
+_OP_TERM_BITS = {"any_a2": SIG_ANY_A2, "any_join": SIG_ANY_JOIN}
+
+# Bits any leap program (strict or hybrid) refuses: these name activity the
+# span programs do not model. The hybrid program models armed-but-unexpired
+# timers, disagreeing fingerprints and a live phase-0 ledger exactly
+# (phasegraph/span.py), so those three bits stay leapable.
+DENSE_BITS = (
+    SIG_ANY_A2 | SIG_ANY_JOIN | SIG_WAIT_ALIVE | SIG_KNOWN_DEAD
+    | SIG_MISSING | SIG_IDENT_STALE | SIG_TOO_FEW
+)
+HYBRID_BITS = SIG_ARMED | SIG_FP_DISAGREE | SIG_KPR_LIVE
+
+_BUCKET_SHIFT = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivityClass:
+    """Host-side decode of one signature fetch (one int32[4] per span).
+
+    ``key`` is the memoization class: term bits | active-row-count bucket
+    (power-of-two buckets, so heterogeneous activity levels share compiled
+    programs within a bucket) — the second cache dimension of the warp
+    runner's bounded program cache. ``mode`` is the engine the class maps
+    to: ``"leap"`` (strictly quiescent — every bit clear), ``"hybrid"``
+    (only hybrid-modelable bits set), or ``"dense"``.
+    """
+
+    key: int
+    expiry: int
+    n_alive: int
+    tick: int
+
+    @property
+    def bits(self) -> int:
+        return self.key & ((1 << _BUCKET_SHIFT) - 1)
+
+    @property
+    def bucket(self) -> int:
+        return self.key >> _BUCKET_SHIFT
+
+    @property
+    def mode(self) -> str:
+        if self.bits & DENSE_BITS:
+            return "dense"
+        return "leap" if self.bits == 0 else "hybrid"
+
+    def describe(self) -> dict:
+        """JSON-able decode (telemetry ledger / summarizer)."""
+        names = {
+            SIG_ANY_A2: "any_a2", SIG_ANY_JOIN: "any_join",
+            SIG_ARMED: "armed", SIG_WAIT_ALIVE: "waiting_on_alive",
+            SIG_KNOWN_DEAD: "known_dead", SIG_MISSING: "missing_alive",
+            SIG_FP_DISAGREE: "fp_disagree", SIG_IDENT_STALE: "ident_stale",
+            SIG_KPR_LIVE: "kpr_live", SIG_TOO_FEW: "too_few",
+        }
+        return {
+            "key": self.key,
+            "mode": self.mode,
+            "terms": [v for b, v in names.items() if self.bits & b],
+            "active_row_bucket": self.bucket,
+        }
+
+
+def decode_signature(row) -> ActivityClass:
+    """``int32[4]`` fetch row -> :class:`ActivityClass`."""
+    k, e, a, t = (int(x) for x in np.asarray(row))
+    return ActivityClass(key=k, expiry=e, n_alive=a, tick=t)
+
+
+@functools.lru_cache(maxsize=None)
+def make_signature_fn(cfg: SwimConfig):
+    """Jitted ``MeshState -> int32[4]``: the on-device activity signature.
+
+    One reduction pass over (S, T) producing ``[class_key,
+    earliest_expiry, n_alive, tick]`` — everything the warp runner needs
+    to pick a span program and length in ONE scalar-row fetch per span
+    decision. ``class_key`` packs the term bits (which phase-op activity
+    terms fire — the planner-derived ``any_a2``/``any_join`` — plus the
+    state-borne sterility terms) with the active-row count bucketed to
+    powers of two. All-bits-clear is exactly :func:`make_quiescence_fn`'s
+    predicate (pinned by tests/test_warp.py); the hybrid-modelable bits
+    (armed / fp_disagree / kpr_live) admit the near-quiescent span program.
+    """
+    from kaboodle_tpu.phasegraph.graph import build_graph
+    from kaboodle_tpu.phasegraph.plan import plan
+
+    # The op-derived terms must stay inside this module's bit vocabulary.
+    hybrid_prog = plan(build_graph(cfg, faulty=False), "hybrid")
+    unknown = set(hybrid_prog.pred_terms) - set(_OP_TERM_BITS)
+    if unknown:
+        raise NotImplementedError(
+            f"hybrid plan declares signature terms {sorted(unknown)} the "
+            "activity signature does not measure — extend horizon.py's "
+            "vocabulary before leaping past them"
+        )
+
+    def signature(st: MeshState) -> jax.Array:  # graftlint: traced
+        S, T, alive = st.state, st.timer, st.alive
+        n = S.shape[-1]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        eye = idx[:, None] == idx[None, :]
+        member = S > 0
+        arow = alive[:, None]
+        acol = alive[None, :]
+
+        waiting = arow & member & (S != KNOWN)
+        armed = jnp.any(waiting)
+        wait_alive = jnp.any(waiting & acol)
+        known_dead = jnp.any(arow & (S == KNOWN) & ~eye & ~acol)
+        missing = jnp.any(arow & acol & ~member & ~eye)
+
+        idv = st.id_view
+        fp = membership_fingerprint(member, idv if idv is not None else st.identity)
+        conv, _, _, n_alive = fingerprint_agreement(alive, fp)
+
+        ident_stale = jnp.bool_(False)
+        if idv is not None:
+            ident_stale = jnp.any(arow & member & (idv != st.identity[None, :]))
+
+        n_row = jnp.sum(member, axis=-1, dtype=jnp.int32)
+        p = st.kpr_partner
+        pc = jnp.clip(p, 0)
+        kpr_live = jnp.any(
+            (p >= 0)
+            & alive[pc]
+            & (st.kpr_fp != fp[pc])
+            & (n_row[pc] <= st.kpr_n)
+        )
+
+        deadline = T.astype(jnp.int32) + jnp.int32(cfg.ping_timeout_ticks)
+        expiry = jnp.min(jnp.where(waiting, deadline, _I32MAX))
+        any_a2 = armed & (expiry <= st.tick)
+
+        join_owed = jnp.bool_(False)
+        if cfg.join_broadcast_enabled:
+            # Conservative: a lonely row becomes rebroadcast-due at a
+            # data-dependent tick, so loneliness itself forces dense.
+            join_owed = jnp.any(alive & st.never_broadcast) | jnp.any(
+                alive & (n_row <= 1)
+            )
+
+        def bit(flag, b):
+            return jnp.where(flag, jnp.int32(b), jnp.int32(0))
+
+        bits = (
+            bit(any_a2, SIG_ANY_A2)
+            | bit(join_owed, SIG_ANY_JOIN)
+            | bit(armed, SIG_ARMED)
+            | bit(wait_alive, SIG_WAIT_ALIVE)
+            | bit(known_dead, SIG_KNOWN_DEAD)
+            | bit(missing, SIG_MISSING)
+            | bit(~conv, SIG_FP_DISAGREE)
+            | bit(ident_stale, SIG_IDENT_STALE)
+            | bit(kpr_live, SIG_KPR_LIVE)
+            | bit(n_alive < 2, SIG_TOO_FEW)
+        )
+
+        # Active-row count, bucketed to powers of two: bucket b covers
+        # (2^(b-2), 2^(b-1)] rows, bucket 0 = none. A cache key, not a
+        # correctness input.
+        cnt = jnp.sum(jnp.any(waiting, axis=-1), dtype=jnp.int32)
+        bucket = jnp.int32(0)
+        for j in [0] + [1 << e for e in range(31)]:
+            bucket += jnp.where(cnt > j, jnp.int32(1), jnp.int32(0))
+
+        key = bits | (bucket << _BUCKET_SHIFT)
+        return jnp.stack([key, expiry, n_alive.astype(jnp.int32), st.tick])
+
+    return jax.jit(signature)

@@ -1,41 +1,48 @@
-"""Warp runners: interleave event-horizon leaps with dense ticks.
+"""Warp runners: signature-classed fast-forward between dense ticks.
 
 The dense runners (sim/runner.py, parallel/mesh.py, fleet/core.py) dispatch
 one full tick kernel per simulated tick, whatever the tick does. These
 runners split every run into *spans* bounded by the event horizon
-(warp/horizon.py): a span whose entry state is quiescent and whose schedule
-carries no events is replayed by the leap kernel (warp/leap.py) in one
-batched dispatch — bit-exact with dense ticking — and everything else runs
-dense. The contract mirrors the dense entry points:
+(warp/horizon.py) and pick a span program by the entry state's **activity
+signature** (Warp 2.0):
 
-- :func:`simulate_warped` — the ``simulate`` twin over a stacked schedule;
-  returns the final state plus the metrics of exactly the densely-executed
-  ticks (leaped ticks have provably constant metrics — converged, full
-  agreement, ``2 * n_alive`` unicasts — so nothing is lost).
-- :func:`run_warped` — advance a fault-free mesh exactly ``ticks`` ticks;
-  ``(state, ticks_run, converged)``, the converge-loop contract.
-- Both accept ``mesh=`` to run the sharded twins: dense ticks through
-  ``parallel.make_sharded_tick``, the leap with its scan carries pinned to
-  the same GSPMD row layout (``parallel.row_matrix_sharding`` /
-  ``parallel.constrain_state``).
-- :func:`fleet_quiescence_mask` / :func:`run_fleet_warped` — the ensemble
-  integration: the horizon predicate vmapped over the ``[E]`` axis gives a
-  per-member mask; while EVERY member is quiescent the whole fleet leaps as
-  one vmapped program (each member under its own key chain and timers —
-  independent leaps inside one dispatch). A mixed fleet runs dense for
-  everyone: under ``vmap`` a per-member branch batches to a select that
-  executes both sides, so skipping work for a subset is impossible — the
-  lockstep price of batching already documented in fleet/core.py; dense is
-  bit-identical for the quiescent members, so nothing diverges.
+- class ``leap`` (every signature bit clear — strict quiescence): the span
+  program (phasegraph/span.py) replays the whole event-free span in
+  batched power-of-two dispatches, bit-exact with dense ticking.
+- class ``hybrid`` (only the hybrid-modelable bits set: timers armed but
+  not expiring in-span, fingerprints disagreeing, a live anti-entropy
+  ledger — the churn-drain / near-quiescent regime): the hybrid program
+  (the strict span + the sterile anti-entropy pass) leaps to
+  ``min(next scheduled event, earliest timer expiry)``; the expiry tick
+  itself and everything after re-classify.
+- class ``dense`` (any other bit — a Join owed, a refutable suspicion, a
+  Known-dead or missing-alive cell, a stale identity view): dense ticks,
+  re-checking the signature every ``recheck_every`` ticks.
 
-Spans leap in power-of-two chunks (``_span_chunks``): leap composition is
-exact (``leap(a)`` then ``leap(b)`` is bit-equal to ``leap(a + b)`` — the
-key chain and timer carry thread through), so a span of any length costs at
-most ``log2(span)`` cached dispatches while the compiled-program cache stays
-bounded at O(log max_span) entries per config instead of one program per
-distinct span length. Dense single-tick programs are cached per config. The
-host drives span selection (span lengths are data-dependent); every
-decision fetch is one scalar per span, not per tick.
+One signature fetch (a single int32[4] row) decides each span: class key,
+earliest expiry, n_alive and the tick counter — the one-fetch-per-span
+budget of the original runner, now carrying the whole decision.
+
+**Bounded program cache**: compiled leap programs are memoized in an
+explicit :class:`ProgramCache` keyed by (config family, strict/hybrid,
+chunk length), where chunk lengths are restricted to powers of two at
+least ``MIN_LEAP`` — a span of any length costs at most ``log2(span)``
+cached dispatches plus up to ``MIN_LEAP - 1`` dense remainder ticks, and
+the cache can hold at most ``len(CHUNK_BUCKETS)`` programs per family *by
+construction* (the cache refuses non-bucket keys). graftscan's KB405
+compile-surface budget gates the same set end to end; the zero-recompile
+fuzz arm (tests/test_fuzz_parity.py) pins that a warmed run compiles
+nothing fresh.
+
+**Per-member fleet warp**: ``run_fleet_warped`` replaces the old
+all-quiescent lockstep mask with per-member horizons — the signature is
+vmapped over the ``[E]`` axis, every leapable member leaps to its OWN next
+event inside one vmapped masked-span dispatch (phasegraph/span.py
+``masked=True``: the span length is a traced per-member ``k_m``, members
+past their horizon freeze), and only members in the dense class ride
+dense ticks, with finished/leapable members frozen via
+``fleet.core.freeze_members``. A heterogeneous ensemble no longer pays
+the lockstep tax: one mid-boot member keeps only itself dense.
 """
 
 from __future__ import annotations
@@ -52,11 +59,93 @@ from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.runner import state_converged
 from kaboodle_tpu.sim.state import MeshState, TickInputs, idle_inputs
 from kaboodle_tpu.warp.horizon import (
+    ActivityClass,
+    decode_signature,
     make_quiescence_fn,
+    make_signature_fn,
     next_static_event,
     static_event_ticks,
 )
 from kaboodle_tpu.warp.leap import make_leap_fn
+
+# The compiled-chunk vocabulary: powers of two >= MIN_LEAP. A span's
+# sub-MIN_LEAP remainder runs as dense ticks instead of compiling tiny leap
+# programs, so the per-family program count is bounded by len(CHUNK_BUCKETS)
+# — the ProgramCache refuses any other key.
+MIN_LEAP = 8
+CHUNK_BUCKETS = tuple(1 << j for j in range(3, 32))  # 8 .. 2^31
+
+
+class ProgramCache:
+    """Explicit bounded cache of compiled span programs.
+
+    Keys are ``(family, kind, k)`` where ``family`` identifies the build
+    (config, mesh, fleet-ness), ``kind`` is ``"strict"``/``"hybrid"`` and
+    ``k`` MUST be a :data:`CHUNK_BUCKETS` power of two — enforcing the
+    bound structurally: per (family, kind) the cache can never hold more
+    than ``len(CHUNK_BUCKETS)`` programs, whatever span lengths the event
+    schedule produces. KB405's compile-surface budget measures the same
+    set from the outside; ``stats()`` exposes it from the inside (the
+    warp2 dryrun asserts it).
+    """
+
+    def __init__(self) -> None:
+        self._programs: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, family, kind: str, k: int, build):
+        if k not in CHUNK_BUCKETS:
+            raise ValueError(
+                f"leap chunk {k} is not a power-of-two bucket >= {MIN_LEAP} "
+                "— the program cache only admits bucketed span lengths"
+            )
+        key = (family, kind, k)
+        prog = self._programs.get(key)
+        if prog is not None:
+            self.hits += 1
+            return prog
+        self.misses += 1
+        prog = build()
+        self._programs[key] = prog
+        return prog
+
+    def stats(self) -> dict:
+        families = {}
+        for family, kind, _k in self._programs:
+            fam = families.setdefault(repr((family, kind)), 0)
+            families[repr((family, kind))] = fam + 1
+        return {
+            "programs": len(self._programs),
+            "per_family_bound": len(CHUNK_BUCKETS),
+            "max_family_programs": max(families.values(), default=0),
+            "hits": self.hits,
+            "misses": self.misses,
+        }
+
+    def clear(self) -> None:
+        self._programs.clear()
+        self.hits = self.misses = 0
+
+
+leap_cache = ProgramCache()
+
+
+def _span_chunks(k: int) -> tuple[list[int], int]:
+    """``(chunks, dense_remainder)``: power-of-two decomposition of a span.
+
+    Chunks are the binary decomposition of ``k`` restricted to
+    >= ``MIN_LEAP``; the remainder (< ``MIN_LEAP``) is returned for the
+    caller to run dense. Leap composition is exact (``leap(a)`` then
+    ``leap(b)`` is bit-equal to ``leap(a + b)`` — the key chain, timers
+    and ledger thread through), so the split never changes the result."""
+    chunks: list[int] = []
+    rem = int(k)
+    while rem >= MIN_LEAP:
+        p = 1 << (rem.bit_length() - 1)
+        chunks.append(p)
+        rem -= p
+    return chunks, rem
 
 
 @functools.lru_cache(maxsize=None)
@@ -68,28 +157,28 @@ def _dense_tick(cfg: SwimConfig, faulty: bool, mesh=None, telemetry: bool = Fals
     return jax.jit(make_sharded_tick(cfg, mesh, faulty=faulty, telemetry=telemetry))
 
 
-@functools.lru_cache(maxsize=None)
-def _alive_count():
-    return jax.jit(lambda st: jnp.sum(st.alive, dtype=jnp.int32))
+def _get_leap(cfg: SwimConfig, k: int, mesh, hybrid: bool):
+    """One bucketed span program through the bounded cache."""
+    kind = "hybrid" if hybrid else "strict"
 
+    def build():
+        if mesh is None:
+            return jax.jit(make_leap_fn(cfg, k, hybrid=hybrid))
+        from kaboodle_tpu.parallel.mesh import constrain_state, row_matrix_sharding
 
-@functools.lru_cache(maxsize=None)
-def _leap(cfg: SwimConfig, k: int, mesh=None):
-    if mesh is None:
-        return jax.jit(make_leap_fn(cfg, k))
-    from kaboodle_tpu.parallel.mesh import constrain_state, row_matrix_sharding
+        sharding = row_matrix_sharding(mesh)
 
-    sharding = row_matrix_sharding(mesh)
+        def constrain(x):
+            return jax.lax.with_sharding_constraint(x, sharding)
 
-    def constrain(x):
-        return jax.lax.with_sharding_constraint(x, sharding)
+        leap = make_leap_fn(cfg, k, constrain=constrain, hybrid=hybrid)
 
-    leap = make_leap_fn(cfg, k, constrain=constrain)
+        def sharded_leap(st: MeshState) -> MeshState:
+            return constrain_state(leap(st), mesh)
 
-    def sharded_leap(st: MeshState) -> MeshState:
-        return constrain_state(leap(st), mesh)
+        return jax.jit(sharded_leap)
 
-    return jax.jit(sharded_leap)
+    return leap_cache.get((cfg, mesh), kind, k, build)
 
 
 @functools.lru_cache(maxsize=None)
@@ -109,22 +198,66 @@ def _slice_tick(inputs: TickInputs, t: int) -> TickInputs:
     return jax.tree.map(lambda x: x[t], inputs)
 
 
-def _span_chunks(k: int):
-    """Power-of-two decomposition of a span length, largest chunk first.
+@dataclasses.dataclass
+class WarpLedger:
+    """Host-side span ledger: what leaped, under which signature class.
 
-    Bounds the leap-program cache (one compiled program per power of two,
-    not per distinct span length) at the cost of <= log2(k) dispatches per
-    span; composition is exact (module docstring)."""
-    while k > 0:
-        p = 1 << (k.bit_length() - 1)
-        yield p
-        k -= p
+    Filled by the runners when passed as ``ledger=``; feeds the telemetry
+    summarizer's per-class leap counters and the bench arms' span
+    accounting. ``spans`` rows: dict(engine, class_key, class, ticks,
+    dispatches)."""
+
+    spans: list = dataclasses.field(default_factory=list)
+
+    def record(self, cls: ActivityClass, engine: str, ticks: int, dispatches: int) -> None:
+        self.spans.append({
+            "engine": engine,
+            "class_key": cls.key,
+            "class": cls.describe(),
+            "ticks": int(ticks),
+            "dispatches": int(dispatches),
+        })
+
+    def per_class(self) -> dict:
+        """``{class_key: {engine, terms, spans, ticks, dispatches}}`` totals."""
+        out: dict = {}
+        for row in self.spans:
+            agg = out.setdefault(
+                row["class_key"],
+                {
+                    "engine": row["engine"],
+                    "terms": row["class"]["terms"],
+                    "active_row_bucket": row["class"]["active_row_bucket"],
+                    "spans": 0,
+                    "ticks": 0,
+                    "dispatches": 0,
+                },
+            )
+            agg["spans"] += 1
+            agg["ticks"] += row["ticks"]
+            agg["dispatches"] += row["dispatches"]
+        return out
 
 
-def _leap_span(state, cfg: SwimConfig, k: int, mesh):
-    for chunk in _span_chunks(k):
-        state = _leap(cfg, chunk, mesh)(state)
-    return state
+def _classify(cls: ActivityClass, hybrid: bool, telemetry: bool = False) -> str:
+    """The engine a span entry state maps to, under the runner's knobs.
+
+    ``hybrid=False`` (the Warp 1.x behavior knob) demotes hybrid-class
+    states to dense; telemetry mode does too — hybrid spans carry
+    data-dependent anti-entropy gossip bytes with no closed form, so exact
+    counter totals require measuring those ticks densely (strictly
+    quiescent spans keep the ``leap_counters`` closed form)."""
+    mode = cls.mode
+    if mode == "hybrid" and (not hybrid or telemetry):
+        return "dense"
+    return mode
+
+
+def _leap_budget(cls: ActivityClass, mode: str, span: int) -> int:
+    """How many of the next ``span`` ticks the class's program may cover."""
+    if mode == "leap":
+        return span
+    return max(0, min(span, cls.expiry - cls.tick))
 
 
 def simulate_warped(
@@ -136,16 +269,19 @@ def simulate_warped(
     mesh=None,
     on_boundary=None,
     telemetry: bool = False,
+    hybrid: bool = True,
+    ledger: WarpLedger | None = None,
 ):
-    """Run a stacked ``[T]`` schedule, fast-forwarding quiescent spans.
+    """Run a stacked ``[T]`` schedule, fast-forwarding (near-)quiescent spans.
 
     The twin of :func:`kaboodle_tpu.sim.runner.simulate`: same state, same
-    schedule, bit-identical final state — but spans with no scheduled event
-    whose entry state passes the quiescence predicate advance through the
-    leap kernel in one dispatch. Non-quiescent event-free stretches (e.g.
-    re-convergence after a fault window) run dense, re-checking the
-    predicate every ``recheck_every`` ticks. ``mesh`` selects the sharded
-    twins for both the dense ticks and the leap.
+    schedule, bit-identical final state — but event-free spans whose entry
+    signature classes as ``leap`` or ``hybrid`` advance through the span
+    programs in bucketed batched dispatches (module docstring). Everything
+    else runs dense, re-checking the signature every ``recheck_every``
+    ticks. ``mesh`` selects the sharded twins for both the dense ticks and
+    the leaps; ``hybrid=False`` restores the strict-quiescence-only
+    behavior (the ``--no-warp-hybrid`` CLI knob).
 
     Returns ``(final_state, dense_ticks, dense_metrics)``: the int32 ``[M]``
     indices of the ticks that executed densely and their stacked
@@ -153,17 +289,20 @@ def simulate_warped(
     state)``, when given, is called at each leap's entry and exit boundary
     with the tick index about to run / just reached — the hook the parity
     fuzz uses to pin state equality at every event-horizon boundary.
+    ``ledger``, when given, accumulates per-span class records
+    (:class:`WarpLedger` — the per-class leap counters surface).
 
     ``telemetry=True`` runs the telemetry-plane dense tick and returns a
     4-tuple ``(final_state, dense_ticks, dense_telemetry, totals)``:
     ``dense_telemetry`` is the densely-executed ticks' stacked
     ``TickTelemetry`` (``None`` if everything leaped) and ``totals`` the
     whole run's ``ProtocolCounters`` sums — dense counters summed plus each
-    leaped span's closed form (``telemetry.counters.leap_counters``:
-    ``k * n_alive`` pings/acks, all else zero — what the dense kernel
-    provably emits on quiescent ticks, pinned by the warp counter-parity
-    fuzz arm). One extra scalar fetch per leap span (``n_alive``), in
-    keeping with the runner's one-fetch-per-span budget.
+    strictly-quiescent leaped span's closed form
+    (``telemetry.counters.leap_counters``: ``k * n_alive`` pings/acks, all
+    else zero). Hybrid-class spans run dense under telemetry (their
+    anti-entropy gossip bytes have no closed form), so totals stay exact;
+    ``n_alive`` rides the signature fetch, keeping the one-fetch-per-span
+    budget.
     """
     from kaboodle_tpu.telemetry.counters import counters_totals, leap_counters
     from kaboodle_tpu.telemetry.trace import host_span
@@ -171,7 +310,7 @@ def simulate_warped(
     T = int(np.asarray(inputs.kill).shape[0])
     eventful = static_event_ticks(inputs)
     tick = _dense_tick(cfg, faulty, mesh, telemetry)
-    quiescent = make_quiescence_fn(cfg)
+    signature = make_signature_fn(cfg)
     recheck_every = max(1, int(recheck_every))
     dense_ticks: list[int] = []
     metrics = []
@@ -180,16 +319,22 @@ def simulate_warped(
     while t < T:
         if not eventful[t]:
             span_end = next_static_event(eventful, t)
-            if bool(quiescent(state)):
+            cls = decode_signature(signature(state))
+            mode = _classify(cls, hybrid, telemetry)
+            k = _leap_budget(cls, mode, span_end - t) if mode != "dense" else 0
+            chunks, rem = _span_chunks(k)
+            if chunks:
+                k -= rem  # the sub-MIN_LEAP tail re-enters the loop densely
                 if on_boundary is not None:
                     on_boundary(t, state)
                 if telemetry:
-                    leap_spans.append(
-                        (span_end - t, int(_alive_count()(state)))
-                    )
-                with host_span(f"leap_span:{span_end - t}"):
-                    state = _leap_span(state, cfg, span_end - t, mesh)
-                t = span_end
+                    leap_spans.append((k, cls.n_alive))
+                with host_span(f"leap_span:{mode}:{k}"):
+                    for chunk in chunks:
+                        state = _get_leap(cfg, chunk, mesh, mode == "hybrid")(state)
+                if ledger is not None:
+                    ledger.record(cls, mode, k, len(chunks))
+                t += k
                 if on_boundary is not None:
                     on_boundary(t, state)
                 continue
@@ -224,27 +369,38 @@ def run_warped(
     ticks: int,
     recheck_every: int = 16,
     mesh=None,
+    hybrid: bool = True,
+    ledger: WarpLedger | None = None,
 ):
     """Advance a fault-free mesh exactly ``ticks`` ticks, leaping spans.
 
     The steady-state-service entry point: a converged idle mesh leaps the
-    whole budget in one dispatch; an unconverged one runs dense (re-checking
-    the horizon every ``recheck_every`` ticks) until quiescence, then leaps
-    the remainder. Returns ``(state, ticks_run, converged)`` — the
-    ``run_until_converged`` contract, with ``ticks_run == ticks`` always
-    (the budget is exact, not a bound) and ``converged`` evaluated on the
-    final state.
+    whole budget in bucketed dispatches; a near-quiescent one (armed
+    timers draining, fingerprints settling) leaps to each successive
+    timer-expiry horizon through the hybrid program and runs only the
+    expiry ticks densely; anything else runs dense (re-checking the
+    signature every ``recheck_every`` ticks) until its class improves.
+    Returns ``(state, ticks_run, converged)`` — the ``run_until_converged``
+    contract, with ``ticks_run == ticks`` always (the budget is exact, not
+    a bound) and ``converged`` evaluated on the final state.
     """
     tick = _dense_tick(cfg, False, mesh)
-    quiescent = make_quiescence_fn(cfg)
+    signature = make_signature_fn(cfg)
     idle = idle_inputs(state.n)
     recheck_every = max(1, int(recheck_every))
     t = 0
     while t < ticks:
-        if bool(quiescent(state)):
-            state = _leap_span(state, cfg, ticks - t, mesh)
-            t = ticks
-            break
+        cls = decode_signature(signature(state))
+        mode = _classify(cls, hybrid)
+        k = _leap_budget(cls, mode, ticks - t) if mode != "dense" else 0
+        chunks, rem = _span_chunks(k)
+        if chunks:
+            for chunk in chunks:
+                state = _get_leap(cfg, chunk, mesh, mode == "hybrid")(state)
+            if ledger is not None:
+                ledger.record(cls, mode, k - rem, len(chunks))
+            t += k - rem
+            continue
         stop = min(ticks, t + recheck_every)
         while t < stop:
             state, _ = tick(state, idle)
@@ -253,7 +409,7 @@ def run_warped(
 
 
 # ---------------------------------------------------------------------------
-# fleet integration
+# fleet integration: per-member horizons
 
 
 @functools.lru_cache(maxsize=None)
@@ -262,29 +418,59 @@ def _fleet_quiescent(cfg: SwimConfig):
 
 
 @functools.lru_cache(maxsize=None)
+def _fleet_signature(cfg: SwimConfig):
+    return jax.jit(jax.vmap(make_signature_fn(cfg)))
+
+
+@functools.lru_cache(maxsize=None)
 def _fleet_converged():
     return jax.jit(jax.vmap(state_converged))
 
 
 @functools.lru_cache(maxsize=None)
-def _fleet_leap(cfg: SwimConfig, k: int):
-    return jax.jit(jax.vmap(make_leap_fn(cfg, k)))
+def _masked_fleet_tick(cfg: SwimConfig):
+    from kaboodle_tpu.fleet.core import freeze_members, make_fleet_tick_fn
+
+    vtick = make_fleet_tick_fn(cfg, faulty=False)
+
+    def step(mesh, idle, active):
+        new, _ = vtick(mesh, idle)
+        return freeze_members(active, mesh, new)
+
+    return jax.jit(step)
 
 
-@functools.lru_cache(maxsize=None)
-def _fleet_tick(cfg: SwimConfig):
-    from kaboodle_tpu.fleet.core import make_fleet_tick_fn
+def _get_fleet_leap(cfg: SwimConfig, K: int):
+    """The vmapped masked hybrid span program, through the bounded cache.
 
-    return jax.jit(make_fleet_tick_fn(cfg, faulty=False))
+    ONE compiled program covers every per-member span length ``k_m <= K``
+    (the length is traced — phasegraph/span.py ``masked=True``), and the
+    hybrid program degenerates bit-exactly to the strict one on strictly
+    quiescent members, so a single (cfg, K) entry serves the whole
+    signature-class mix of an ensemble round."""
+
+    def build():
+        return jax.jit(jax.vmap(make_leap_fn(cfg, K, hybrid=True, masked=True)))
+
+    return leap_cache.get((cfg, "fleet"), "hybrid", K, build)
 
 
 def fleet_quiescence_mask(fleet, cfg: SwimConfig) -> jax.Array:
-    """bool ``[E]``: per-member event horizon — which members could leap now.
+    """bool ``[E]``: per-member strict event horizon (Warp 1.x surface).
 
     The quiescence predicate vmapped over the ensemble axis; computed
-    on-device, one bool per member (feed it to stats or fetch it once per
-    span — never per tick)."""
+    on-device, one bool per member. Superseded by
+    :func:`fleet_signature` for the per-member runner but kept as the
+    cheap "who could leap right now" probe."""
     return _fleet_quiescent(cfg)(fleet.mesh)
+
+
+def fleet_signature(fleet, cfg: SwimConfig) -> jax.Array:
+    """int32 ``[E, 4]``: per-member activity signature rows.
+
+    Each row is ``[class_key, earliest_expiry, n_alive, tick]`` —
+    everything the per-member warp loop needs, one fetch per round."""
+    return _fleet_signature(cfg)(fleet.mesh)
 
 
 def run_fleet_warped(
@@ -292,39 +478,89 @@ def run_fleet_warped(
     cfg: SwimConfig,
     ticks: int,
     recheck_every: int = 16,
+    hybrid: bool = True,
+    ledger: WarpLedger | None = None,
 ):
     """Advance every fleet member exactly ``ticks`` fault-free ticks.
 
-    While the per-member horizon mask is all-quiescent the whole ensemble
-    leaps as ONE vmapped program — each member under its own key chain and
-    timers, i.e. E independent leaps in a single dispatch. Any unquiescent
-    member sends the whole fleet dense for ``recheck_every`` ticks (the
-    vmap-lockstep price — see module docstring); dense is bit-identical for
-    the members that could have leaped, so per-member trajectories match
-    standalone :func:`run_warped` runs either way (tests/test_warp.py).
+    Per-member horizons (Warp 2.0): each round fetches the vmapped
+    signature rows and computes, per member, how far it may leap — its
+    remaining budget for strictly-quiescent members, its own
+    timer-expiry horizon for hybrid-class members, zero for dense-class
+    or finished members. If anyone can cover at least ``MIN_LEAP`` ticks,
+    the whole ensemble enters ONE vmapped masked-span dispatch in which
+    every member leaps exactly its own ``k_m`` (members at ``k_m == 0``
+    freeze bit-exactly); otherwise the unfinished members ride dense
+    ticks with everyone else frozen (``fleet.core.freeze_members``). The
+    old all-quiescent lockstep mask — where a single mid-boot member
+    forced the entire ensemble dense — is gone; dense ticks are only paid
+    by the members that need them.
 
-    Fault-free only (the leap's precondition): the per-member ``drop_rate``
-    knob is inert here, exactly as in ``run_fleet_until_converged``'s
-    default mode. Returns ``(fleet, ticks_run, converged)`` with
-    ``converged`` a per-member ``[E]`` bool of the final states.
+    Fault-free only (the span programs' precondition): the per-member
+    ``drop_rate`` knob is inert here, exactly as in
+    ``run_fleet_until_converged``'s default mode. Returns
+    ``(fleet, ticks_run, converged)`` with ``converged`` a per-member
+    ``[E]`` bool of the final states; member trajectories are bit-exact
+    with standalone :func:`run_warped` runs (tests/test_warp.py).
     """
     from kaboodle_tpu.fleet.core import fleet_idle_inputs
 
     mesh_state = fleet.mesh
-    idle = fleet_idle_inputs(fleet.n, fleet.ensemble)
-    tick = _fleet_tick(cfg)
+    ensemble = fleet.ensemble
+    idle = fleet_idle_inputs(fleet.n, ensemble)
     recheck_every = max(1, int(recheck_every))
-    t = 0
-    while t < ticks:
-        mask = np.asarray(_fleet_quiescent(cfg)(mesh_state))
-        if mask.all():
-            for chunk in _span_chunks(ticks - t):
-                mesh_state = _fleet_leap(cfg, chunk)(mesh_state)
-            t = ticks
+    target = None
+    while True:
+        rows = np.asarray(_fleet_signature(cfg)(mesh_state))  # one [E, 4] fetch
+        t_m = rows[:, 3].astype(np.int64)
+        if target is None:
+            target = t_m + int(ticks)
+        remaining = target - t_m
+        if (remaining <= 0).all():
             break
-        stop = min(ticks, t + recheck_every)
-        while t < stop:
-            mesh_state, _ = tick(mesh_state, idle)
-            t += 1
+        k_m = np.zeros((ensemble,), dtype=np.int64)
+        classes = [decode_signature(rows[e]) for e in range(ensemble)]
+        for e, cls in enumerate(classes):
+            if remaining[e] <= 0:
+                continue
+            mode = _classify(cls, hybrid)
+            if mode != "dense":
+                k_m[e] = _leap_budget(cls, mode, int(remaining[e]))
+        if k_m.max() >= MIN_LEAP:
+            # One vmapped masked dispatch: everyone leaps its own horizon
+            # (including sub-MIN_LEAP free riders — they share the program).
+            K = 1 << int(k_m.max() - 1).bit_length()
+            K = max(K, MIN_LEAP)
+            mesh_state = _get_fleet_leap(cfg, K)(
+                mesh_state, jnp.asarray(k_m, dtype=jnp.int32)
+            )
+            if ledger is not None:
+                # The whole round is ONE vmapped dispatch: record one row
+                # per signature class present among the leapers (ticks
+                # summed over that class's members), each carrying the
+                # round's single dispatch — never one dispatch per member.
+                per_round: dict[int, list] = {}
+                for e, cls in enumerate(classes):
+                    if k_m[e] > 0:
+                        row = per_round.setdefault(cls.key, [cls, 0, 0])
+                        row[1] += int(k_m[e])
+                        row[2] += 1
+                for cls, ticks_sum, members in per_round.values():
+                    ledger.spans.append({
+                        "engine": "fleet-" + _classify(cls, hybrid),
+                        "class_key": cls.key,
+                        "class": cls.describe(),
+                        "ticks": ticks_sum,
+                        "dispatches": 1,
+                        "members": members,
+                    })
+            continue
+        # Nobody can leap a full chunk: dense ticks for unfinished members
+        # (leapable-but-short members ride along — dense is bit-identical
+        # for them), everyone else frozen.
+        steps = int(min(recheck_every, remaining[remaining > 0].min()))
+        active = jnp.asarray(remaining > 0)
+        for _ in range(steps):
+            mesh_state = _masked_fleet_tick(cfg)(mesh_state, idle, active)
     converged = _fleet_converged()(mesh_state)
-    return dataclasses.replace(fleet, mesh=mesh_state), jnp.int32(t), converged
+    return dataclasses.replace(fleet, mesh=mesh_state), jnp.int32(ticks), converged

@@ -168,6 +168,98 @@ def test_random_sparse_schedule_warp_arm(seed):
         )
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_near_quiescent_schedule_hybrid_arm(seed):
+    """Warp 2.0 fuzz arm: randomized near-quiescent schedules — sparse
+    kills clustered early, long calm spans, a drain-shaped suspicion
+    timeout — drive the HYBRID signature class (armed timers on dead
+    peers, disagreeing fingerprints, live anti-entropy), and the warped
+    run must equal dense tick-by-tick at every event-horizon boundary and
+    at termination. Then the zero-recompile check after signature-class
+    warmup: re-dispatching the same schedule through the warmed runner
+    compiles NOTHING fresh — the per-class memoization holds."""
+    import jax
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.sim.kernel import make_tick_fn
+    from kaboodle_tpu.sim.state import TickInputs, idle_inputs
+    from kaboodle_tpu.warp.runner import WarpLedger, simulate_warped
+
+    assert_counter_live()
+
+    rng = np.random.default_rng(7000 + seed)
+    n = int(rng.integers(14, 22))
+    ticks = int(rng.integers(80, 120))
+    cfg = SwimConfig(
+        deterministic=bool(rng.integers(2)),
+        ping_timeout_ticks=int(rng.integers(28, 48)),
+    )
+    lean = bool(rng.integers(2))
+    st = init_state(n, seed=seed, ring_contacts=n - 1, announced=True,
+                    track_latency=not lean, instant_identity=lean,
+                    timer_dtype=jnp.int16 if lean else jnp.int32)
+
+    # Sparse suspect rows over long calm spans: 1-2 early kills, nothing
+    # else — the drain (discovery, waiting windows, expiry seasons) is the
+    # whole schedule.
+    idle = idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), dtype=bool)
+    for v in rng.choice(np.arange(1, n), size=int(rng.integers(1, 3)),
+                        replace=False):
+        kill[int(rng.integers(0, 6)), v] = True
+    inputs = TickInputs(
+        kill=jnp.asarray(kill),
+        revive=idle.revive,
+        partition=idle.partition,
+        drop_rate=idle.drop_rate,
+        manual_target=idle.manual_target,
+        drop_ok=None,
+    )
+
+    tick_fn = jax.jit(make_tick_fn(cfg, faulty=True))
+    sd = st
+    dense_states = []
+    for t in range(ticks):
+        sd, _ = tick_fn(sd, jax.tree.map(lambda x: x[t], inputs))
+        dense_states.append(sd)
+
+    boundaries = []
+    ledger = WarpLedger()
+    wf, dense_ticks, _ = simulate_warped(
+        st, inputs, cfg, faulty=True, recheck_every=4,
+        on_boundary=lambda t, s: boundaries.append((t, s)),
+        ledger=ledger,
+    )
+
+    def assert_equal(a, b, ctx):
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            xv, yv = np.asarray(x), np.asarray(y)
+            if xv.dtype == np.float32:
+                ok = ((xv == yv) | (np.isnan(xv) & np.isnan(yv))).all()
+            else:
+                ok = (xv == yv).all()
+            assert ok, f"hybrid warp mismatch {ctx} (seed {seed})"
+
+    assert_equal(sd, wf, "at termination")
+    for t, s in boundaries:
+        assert_equal(st if t == 0 else dense_states[t - 1], s, f"boundary {t}")
+    # The near-quiescent generator must actually drive the hybrid path.
+    assert any(r["engine"] == "hybrid" for r in ledger.spans), (
+        f"seed {seed}: no hybrid span fired — generator regression"
+    )
+
+    # --- zero fresh compiles after signature-class warmup -----------------
+    with compile_counter() as box:
+        simulate_warped(st, inputs, cfg, faulty=True, recheck_every=4)
+    assert box.count == 0, (
+        f"{box.count} fresh compiles re-dispatching a warmed near-quiescent "
+        f"schedule (seed {seed}) — the signature-class memoization broke"
+    )
+
+
 @pytest.mark.parametrize("seed", range(2))
 def test_recompile_counter_zero_after_warmup(seed):
     """The graftscan KB405 property as a fuzz arm: a 64-tick randomized

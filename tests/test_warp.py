@@ -25,14 +25,21 @@ from kaboodle_tpu.sim.runner import run_until_converged, simulate
 from kaboodle_tpu.sim.scenario import Scenario
 from kaboodle_tpu.sim.state import idle_inputs, init_state
 from kaboodle_tpu.warp.horizon import (
+    decode_signature,
+    earliest_timer_expiry,
     make_expiry_fn,
     make_quiescence_fn,
+    make_signature_fn,
     next_static_event,
     static_event_ticks,
 )
 from kaboodle_tpu.warp.leap import make_leap_fn
 from kaboodle_tpu.warp.runner import (
+    CHUNK_BUCKETS,
+    MIN_LEAP,
+    WarpLedger,
     fleet_quiescence_mask,
+    leap_cache,
     run_fleet_warped,
     run_warped,
     simulate_warped,
@@ -295,6 +302,291 @@ def test_fleet_warp_all_quiescent_leaps():
     for k in range(e):
         ref, _, _ = run_warped(member_state(fleet, k), cfg, ticks)
         _assert_leaves_equal(ref, member_state(out, k), f"member {k}")
+
+
+# ---------------------------------------------------------------------------
+# Warp 2.0: activity signature + hybrid (near-quiescent) spans
+
+
+def _drain_state(n, cfg, victims, seed=3, max_dense=80, **state_kw):
+    """A mid-drain near-quiescent state, built by running the REAL engine:
+    kill ``victims``, then tick densely until the signature classes the
+    state ``hybrid`` (every survivor's cell for the dead peers armed)."""
+    st = _converged_init(n, seed=seed, **state_kw)
+    inp = Scenario(n, 1, seed=0).kill_at(0, victims).build()
+    st, _ = jax.jit(make_tick_fn(cfg, faulty=True))(
+        st, jax.tree.map(lambda x: x[0], inp)
+    )
+    tick = jax.jit(make_tick_fn(cfg, faulty=False))
+    sig = make_signature_fn(cfg)
+    idle = idle_inputs(n)
+    for _ in range(max_dense):
+        if decode_signature(sig(st)).mode == "hybrid":
+            return st
+        st, _ = tick(st, idle)
+    raise AssertionError("drain never reached the hybrid class")
+
+
+def test_signature_classes_and_quiescence_equivalence():
+    """Class decode: converged -> leap, mid-boot -> dense, armed drain ->
+    hybrid; and bits == 0 is exactly the strict quiescence predicate."""
+    n = 20
+    cfg = SwimConfig(ping_timeout_ticks=40)
+    sig = make_signature_fn(cfg)
+    q = make_quiescence_fn(cfg)
+
+    conv = _converged_init(n)
+    c = decode_signature(sig(conv))
+    assert c.mode == "leap" and c.bits == 0 and c.describe()["terms"] == []
+
+    boot = init_state(n, seed=0, ring_contacts=2)
+    cb = decode_signature(sig(boot))
+    assert cb.mode == "dense" and "missing_alive" in cb.describe()["terms"]
+
+    drain = _drain_state(n, cfg, [n // 2])
+    cd = decode_signature(sig(drain))
+    assert cd.mode == "hybrid" and "armed" in cd.describe()["terms"]
+    assert cd.expiry > cd.tick  # the hybrid class always has a window
+    assert cd.bucket >= 1  # active rows counted
+    assert earliest_timer_expiry(drain, cfg) == cd.expiry
+
+    # A waiting cell on an ALIVE peer is refutable -> dense, never hybrid.
+    state = np.asarray(conv.state).copy()
+    state[0, 1] = 2  # WAITING_FOR_PING on an alive peer
+    wa = dataclasses.replace(conv, state=jnp.asarray(state))
+    cwa = decode_signature(sig(wa))
+    assert cwa.mode == "dense" and "waiting_on_alive" in cwa.describe()["terms"]
+
+    for st in (conv, boot, drain, wa):
+        assert (decode_signature(sig(st)).bits == 0) == bool(q(st))
+
+
+@pytest.mark.parametrize("det,lean", [(True, False), (False, False), (False, True)])
+def test_hybrid_leap_matches_dense_on_drain(det, lean):
+    """The hybrid span program vs dense over a real mid-drain state (armed
+    timers on dead peers), per state variant — including the masked
+    (traced-k) build at k_m == k and k_m == 0."""
+    n, k = 24, 8
+    cfg = SwimConfig(deterministic=det, ping_timeout_ticks=48)
+    kw = dict(track_latency=not lean, instant_identity=lean,
+              timer_dtype=jnp.int16 if lean else jnp.int32)
+    st = _drain_state(n, cfg, [5, 11], **kw)
+    dense, _ = _dense_trajectory(st, cfg, k)
+    _assert_leaves_equal(
+        dense, jax.jit(make_leap_fn(cfg, k, hybrid=True))(st), "hybrid"
+    )
+    masked = jax.jit(make_leap_fn(cfg, 16, hybrid=True, masked=True))
+    _assert_leaves_equal(dense, masked(st, jnp.int32(k)), "masked k_m=k")
+    _assert_leaves_equal(st, masked(st, jnp.int32(0)), "masked k_m=0")
+
+
+def test_hybrid_leap_sterile_ae_fires_and_matches_dense():
+    """A drain state with DISAGREEING fingerprints (half the rows already
+    removed a victim): anti-entropy candidates fire every tick — the
+    sterile-AE machinery (partner selection, request/reply timer marks,
+    kpr ledger) must reproduce dense bit-for-bit, and the kpr ledger must
+    show live partners (proving the path was actually exercised)."""
+    n, k = 24, 10
+    cfg = SwimConfig(ping_timeout_ticks=64)
+    st = _drain_state(n, cfg, [5, 11])
+    # Half the survivors have already worked victim 5 out of their map.
+    S = np.asarray(st.state).copy()
+    alive = np.asarray(st.alive)
+    rows = np.arange(n) >= n // 2
+    S[alive & rows, 5] = 0
+    st = dataclasses.replace(st, state=jnp.asarray(S))
+    sig = decode_signature(make_signature_fn(cfg)(st))
+    assert sig.mode == "hybrid"
+    assert "fp_disagree" in sig.describe()["terms"]
+    dense, _ = _dense_trajectory(st, cfg, k)
+    hyb = jax.jit(make_leap_fn(cfg, k, hybrid=True))(st)
+    _assert_leaves_equal(dense, hyb, "sterile AE")
+    assert (np.asarray(hyb.kpr_partner) >= 0).any(), "AE never fired"
+
+
+def test_run_warped_drain_crosses_expiry_bit_exact():
+    """run_warped over a budget that crosses the first timer expiry: hybrid
+    spans leap the waiting window, the expiry/escalation season runs
+    dense, and the whole budget is bit-exact with dense ticking. The
+    ledger records hybrid spans."""
+    n = 24
+    cfg = SwimConfig(ping_timeout_ticks=32)
+    st = _drain_state(n, cfg, [7])
+    ticks = (earliest_timer_expiry(st, cfg) - int(st.tick)) + 24
+    dense, _ = _dense_trajectory(st, cfg, ticks)
+    ledger = WarpLedger()
+    out, ticks_run, _ = run_warped(st, cfg, ticks, recheck_every=4,
+                                   ledger=ledger)
+    assert int(ticks_run) == ticks
+    _assert_leaves_equal(dense, out, "drain crossing expiry")
+    assert any(r["engine"] == "hybrid" for r in ledger.spans)
+
+
+def test_hybrid_disabled_knob_still_bit_exact():
+    """hybrid=False (the --no-warp-hybrid knob) demotes hybrid-class spans
+    to dense — slower, never wrong."""
+    n = 20
+    cfg = SwimConfig(ping_timeout_ticks=32)
+    st = _drain_state(n, cfg, [9])
+    ticks = 16
+    dense, _ = _dense_trajectory(st, cfg, ticks)
+    ledger = WarpLedger()
+    out, _, _ = run_warped(st, cfg, ticks, hybrid=False, ledger=ledger)
+    _assert_leaves_equal(dense, out, "hybrid off")
+    assert not any(r["engine"] == "hybrid" for r in ledger.spans)
+
+
+# ---------------------------------------------------------------------------
+# satellite: earliest_timer_expiry boundary cases
+
+
+def _arm_cell(st, row, col, timer_val):
+    """Kill ``col`` and leave exactly ONE armed waiting cell on it (at
+    ``row``); every other survivor has already purged it — the minimal
+    hybrid-class state with a single timer horizon."""
+    state = np.asarray(st.state).copy()
+    timer = np.asarray(st.timer).copy()
+    alive = np.asarray(st.alive).copy()
+    alive[col] = False  # waiting cells must point at dead peers (hybrid class)
+    state[:, col] = 0  # everyone else already purged the dead peer
+    state[row, col] = 2  # WAITING_FOR_PING
+    timer[row, col] = timer_val
+    state[col] = 0  # dead row's map frozen empty (post-purge shape)
+    state[col, col] = 1
+    return dataclasses.replace(
+        st, state=jnp.asarray(state), timer=jnp.asarray(timer),
+        alive=jnp.asarray(alive),
+    )
+
+
+@pytest.mark.parametrize("offset", [0, 1])
+def test_expiry_on_span_last_tick_and_first_after(offset):
+    """A timer expiring exactly on the span's last tick (the span must
+    shrink so the expiry tick runs dense) vs on the first tick after the
+    span (the whole span leaps) — each pinned bit-exact against dense.
+
+    With expiry at entry_tick + span - offset: offset=1 puts the A2 fire
+    INSIDE the naive span, offset=0 exactly at its end (first tick after
+    the span's last leaped tick)."""
+    n, span = 20, 12
+    cfg = SwimConfig(ping_timeout_ticks=64)
+    st = _converged_init(n, seed=2)
+    t0 = int(st.tick)
+    # deadline = timer + timeout; place it at t0 + span - offset.
+    st = _arm_cell(st, 3, 8, t0 + span - offset - cfg.ping_timeout_ticks)
+    assert earliest_timer_expiry(st, cfg) == t0 + span - offset
+    dense, _ = _dense_trajectory(st, cfg, span)
+    ledger = WarpLedger()
+    out, ticks_run, _ = run_warped(st, cfg, span, recheck_every=2,
+                                   ledger=ledger)
+    assert int(ticks_run) == span
+    _assert_leaves_equal(dense, out, f"expiry offset {offset}")
+    # The leaped portion never covers the expiry tick itself.
+    leaped = sum(r["ticks"] for r in ledger.spans)
+    assert leaped <= span - offset
+
+
+def test_expiry_interleaved_with_scheduled_event():
+    """A scheduled manual ping INSIDE the waiting window: the span must
+    stop at the event even though the timer horizon is further out, and
+    the whole schedule stays bit-exact with dense."""
+    n, T = 20, 24
+    cfg = SwimConfig(ping_timeout_ticks=18)
+    st = _converged_init(n, seed=4)
+    t0 = int(st.tick)
+    st = _arm_cell(st, 2, 9, t0)  # expiry at t0 + 18
+    sc = Scenario(n, T, seed=0).manual_ping_at(6, 0, 3)  # event before expiry
+    inp = sc.build()
+    tick = jax.jit(make_tick_fn(cfg, faulty=True))
+    sd = st
+    for t in range(T):
+        sd, _ = tick(sd, jax.tree.map(lambda x: x[t], inp))
+    wf, dense_ticks, _ = simulate_warped(st, inp, cfg, faulty=True,
+                                         recheck_every=4)
+    _assert_leaves_equal(sd, wf, "event inside waiting window")
+    dense_set = set(int(t) for t in dense_ticks)
+    assert 6 in dense_set  # the scheduled event ran dense
+    assert 18 in dense_set  # the expiry tick ran dense too
+
+
+# ---------------------------------------------------------------------------
+# satellite: the bounded program cache
+
+
+def test_program_cache_rejects_non_bucket_chunks():
+    with pytest.raises(ValueError, match="power-of-two bucket"):
+        leap_cache.get(("fam",), "strict", 12, lambda: None)
+    with pytest.raises(ValueError, match="power-of-two bucket"):
+        leap_cache.get(("fam",), "strict", MIN_LEAP // 2, lambda: None)
+
+
+def test_program_cache_bounded_across_irregular_span_lengths():
+    """Irregular event schedules (many distinct span lengths) compile at
+    most len(CHUNK_BUCKETS) programs per family — the regression this
+    satellite fixes is one compiled program per distinct span length."""
+    n = 16
+    cfg = SwimConfig()
+    st = _converged_init(n, seed=6)
+    before = {k for k in leap_cache._programs if k[0] == (cfg, None)}
+    for ticks in (9, 11, 13, 17, 21, 27, 33, 41, 53, 61):
+        out, ticks_run, _ = run_warped(st, cfg, ticks)
+        assert int(ticks_run) == ticks
+    after = {k for k in leap_cache._programs if k[0] == (cfg, None)}
+    new = after - before
+    # every new program is a bucket, and far fewer than distinct lengths
+    assert all(k[2] in CHUNK_BUCKETS for k in new)
+    assert len(new) <= len(CHUNK_BUCKETS)
+    stats = leap_cache.stats()
+    assert stats["max_family_programs"] <= stats["per_family_bound"]
+
+
+# ---------------------------------------------------------------------------
+# Warp 2.0 fleet: per-member horizons
+
+
+def test_fleet_per_member_horizons_heterogeneous_parity():
+    """A 3-member fleet — converged, mid-drain (hybrid class), mid-boot
+    (dense class) — advances each member bit-exactly to its standalone
+    dense trajectory, with the leapable members actually leaping (ledger)
+    while the boot member rides dense: the lockstep tax is gone."""
+    from kaboodle_tpu.fleet.core import FleetState, member_state
+
+    n, ticks = 20, 24
+    cfg = SwimConfig(ping_timeout_ticks=64)
+    members = [
+        _converged_init(n, seed=0),
+        _drain_state(n, cfg, [n // 2], seed=1),
+        init_state(n, seed=2, ring_contacts=2),
+    ]
+    # Align tick counters? No — members keep their own clocks; the runner
+    # targets each member's entry tick + budget independently.
+    mesh_state = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *members)
+    fleet = FleetState(mesh=mesh_state, drop_rate=jnp.zeros((3,), jnp.float32))
+    ledger = WarpLedger()
+    out, ticks_run, conv = run_fleet_warped(fleet, cfg, ticks,
+                                            recheck_every=4, ledger=ledger)
+    assert int(ticks_run) == ticks
+    for e in range(3):
+        ref, _ = _dense_trajectory(members[e], cfg, ticks)
+        _assert_leaves_equal(ref, member_state(out, e), f"member {e}")
+    engines = {r["engine"] for r in ledger.spans}
+    assert engines & {"fleet-leap", "fleet-hybrid"}, engines
+
+
+def test_fleet_per_member_matches_standalone_run_warped():
+    """Member k of a warped fleet == the standalone run_warped result (both
+    equal dense, transitively — pinned directly here)."""
+    from kaboodle_tpu.fleet.core import FleetState, member_state
+
+    n, ticks = 16, 20
+    cfg = SwimConfig(ping_timeout_ticks=48)
+    members = [_converged_init(n, seed=0), _drain_state(n, cfg, [3], seed=5)]
+    mesh_state = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *members)
+    fleet = FleetState(mesh=mesh_state, drop_rate=jnp.zeros((2,), jnp.float32))
+    out, _, _ = run_fleet_warped(fleet, cfg, ticks)
+    for e in range(2):
+        ref, _, _ = run_warped(members[e], cfg, ticks)
+        _assert_leaves_equal(ref, member_state(out, e), f"member {e}")
 
 
 # ---------------------------------------------------------------------------

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -63,6 +63,7 @@ ci: lint native test
 	$(MAKE) warp2-dryrun
 	$(MAKE) telemetry-dryrun
 	$(MAKE) phasegraph-dryrun
+	$(MAKE) serve-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -115,6 +116,19 @@ telemetry-dryrun:
 # `python bench.py --fastpath-ab` (PERF.md "Phase graph").
 phasegraph-dryrun:
 	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu phasegraph
+
+# Serve dryrun (gossip-as-a-service, ISSUE 10) at toy scale: the full
+# stack in one process — engine over a 4-lane resident pool, asyncio TCP
+# server on an ephemeral port, client + live stream connection — driving
+# 8 mixed requests plus the park/spill/restore/resume/cancel lifecycle,
+# asserting the three service contracts from the inside: zero fresh
+# compiles after warmup (KB405 counter), harvest bit-exact with a
+# standalone run_until_converged, streamed records == written manifest
+# (schema-gated). The measured throughput/latency acceptance run is
+# `python bench.py --serve` / `python -m kaboodle_tpu serve-load`
+# (PERF.md "Serving", BENCH_serve.json); CI only proves the contracts.
+serve-dryrun:
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

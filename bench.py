@@ -1142,6 +1142,13 @@ def main() -> None:
                         "standalone 2-pass fused program on the steady-state "
                         "scan, bit-exactness checked first) instead of the "
                         "standard sections; same JSON tail contract")
+    p.add_argument("--serve", action="store_true",
+                   help="run the serving load benchmark (closed + open loop "
+                        "against an in-process gossip-as-a-service server, "
+                        "throughput + p50/p99 + the zero-recompile pin) "
+                        "instead of the standard sections; delegates to "
+                        "`python -m kaboodle_tpu serve-load` and writes "
+                        "BENCH_serve.json")
     p.add_argument("--manifest", metavar="PATH", default=None,
                    help="append the BENCHDOC line as a 'run' record to a "
                         "JSONL telemetry manifest (kaboodle_tpu.telemetry."
@@ -1166,6 +1173,15 @@ def main() -> None:
     backend = jax.default_backend()
     n_chips = jax.device_count()
     on_tpu = backend not in ("cpu",)
+
+    if args.serve:
+        # Thin delegation: the serving load benchmark owns its own server
+        # lifecycle, warmup accounting and JSON output (BENCH_serve.json),
+        # so bench.py just routes to it with the shared --n knob.
+        from kaboodle_tpu.serve.loadgen import main as serve_load_main
+
+        argv = ["--n", str(args.n)] if args.n else []
+        raise SystemExit(serve_load_main(argv))
 
     if args.warp:
         # Focused warp A/B lanes. 'sparse-fault': ISSUE 3 acceptance (>= 2x

@@ -257,6 +257,77 @@ def _leap_fleet_masked():
     return leap, (fleet.mesh, k_m)
 
 
+def _serve_lane_vectors():
+    import jax.numpy as jnp
+
+    return (
+        jnp.ones((TRACE_E,), bool),  # active
+        jnp.ones((TRACE_E,), bool),  # until_conv
+        jnp.full((TRACE_E,), 16, jnp.int32),  # remaining
+        jnp.zeros((TRACE_E,), jnp.int32),  # ticks_run
+        jnp.full((TRACE_E,), -1, jnp.int32),  # conv_tick
+    )
+
+
+def _serve_step():
+    # The serve pool's resident chunk program (phasegraph serve engine):
+    # masked converge chunks with per-lane modes/budgets all traced — the
+    # program the server dispatches every dense round, forever.
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_serve_step
+
+    fleet = init_fleet(TRACE_N // 2, TRACE_E)
+    fn = make_serve_step(_cfg(), chunk=4)
+    return fn, (fleet.mesh, fleet.drop_rate, *_serve_lane_vectors())
+
+
+def _serve_step_telemetry():
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_serve_step
+
+    fleet = init_fleet(TRACE_N // 2, TRACE_E)
+    fn = make_serve_step(_cfg(), chunk=4, telemetry=True)
+    return fn, (fleet.mesh, fleet.drop_rate, *_serve_lane_vectors())
+
+
+def _serve_reseed():
+    # The on-device retire/re-seed scatter: init_state under trace (lane,
+    # seed, knob all traced) + generation bump — the mid-flight admission
+    # program. KB403 pins that the fresh member state is BUILT on device,
+    # not captured as a host constant.
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.serve.pool import make_reseed_fn
+
+    n = TRACE_N // 2
+    fleet = init_fleet(n, TRACE_E)
+    generation = jnp.zeros((TRACE_E,), jnp.int32)
+    fn = make_reseed_fn(n)
+    return fn, (
+        fleet.mesh, generation, fleet.drop_rate,
+        jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+    )
+
+
+def _serve_leap():
+    # The serve engine's warped-lane variant: the SAME masked per-member
+    # hybrid leap family as phasegraph.leap.fleet, registered under the
+    # serve name because the serve round loop dispatches it directly
+    # (horizon-mode lanes fast-forward; k_m == 0 lanes freeze).
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+
+    n = TRACE_N // 2
+    fleet = init_fleet(n, TRACE_E, ring_contacts=n - 1, announced=True)
+    leap = jax.vmap(make_warp_leap(_cfg(), LEAP_K, hybrid=True, masked=True))
+    k_m = jnp.array([LEAP_K, 0, LEAP_K // 2, 0], dtype=jnp.int32)
+    return leap, (fleet.mesh, k_m)
+
+
 def _tick_fleet():
     from kaboodle_tpu.fleet.core import fleet_idle_inputs, init_fleet
     from kaboodle_tpu.phasegraph.derive import make_fleet_tick
@@ -392,6 +463,13 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("phasegraph.leap.hybrid.lean", _leap_hybrid_lean, lean=True),
     EntryPoint("phasegraph.leap.fleet", _leap_fleet_masked),
     EntryPoint("phasegraph.tick.fleet", _tick_fleet),
+    # serve (ISSUE 10): the resident service program set — the chunk
+    # program (dense + telemetry), the admission re-seed scatter, and the
+    # warped-lane leap dispatch.
+    EntryPoint("phasegraph.serve.step", _serve_step),
+    EntryPoint("phasegraph.serve.step.telemetry", _serve_step_telemetry),
+    EntryPoint("serve.reseed", _serve_reseed),
+    EntryPoint("serve.leap", _serve_leap),
     EntryPoint("phasegraph.tick.sharded", _tick_sharded, sharded=True),
     EntryPoint("phasegraph.leap.sharded", _leap_sharded, sharded=True),
     EntryPoint("phasegraph.tick.fleet.sharded", _tick_fleet_sharded, sharded=True),

@@ -95,7 +95,7 @@ def run_scan(
     measured: dict[str, int] = {}
     if with_surface:
         if progress:
-            progress("graftscan: measuring compile surface (dense+warp+fleet)")
+            progress("graftscan: measuring compile surface (dense+warp+fleet+serve)")
         measured = surface_mod.measure_surface()
 
     findings.sort(key=lambda f: (f.path, f.rule, f.symbol))

@@ -289,10 +289,48 @@ def _run_fleet(ctx) -> None:
     simulate_fleet(ctx["fleet2"], ctx["inputs"], cfg, faulty=True)
 
 
+def _prep_serve():
+    from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.warp import runner
+
+    # The serve engine warms its own leap buckets and signature program;
+    # start from cold warp caches so the count is the serve surface, not
+    # whatever the warp exercise left behind.
+    runner.leap_cache.clear()
+    runner._fleet_signature.cache_clear()
+
+    pool = LanePool(_EX_N // 2, _EX_E, cfg=_cfg(), chunk=4)
+    engine = ServeEngine([pool], warp=True, max_leap=16)
+    return {"engine": engine, "request": ServeRequest}
+
+
+def _run_serve(ctx) -> None:
+    """The serving surface: engine warmup (pool program set + signature +
+    leap buckets 8/16), then admit→converge→re-seed cycles and a
+    horizon/leap request. Everything compiles in warmup — the cycles
+    after it are the zero-recompile-after-warmup contract, so any budget
+    growth here is a recompilation regression on the serving path."""
+    engine = ctx["engine"]
+    request = ctx["request"]
+    n = _EX_N // 2
+    engine.warmup()
+    # Two full admit -> converge -> harvest -> re-seed cycles through the
+    # same lane (the second proves the re-seed path hits the cache)...
+    for seed in (0, 1):
+        engine.submit(request(n=n, seed=seed))
+        engine.drain()
+    # ...and one horizon-mode steady request that rides the leap path.
+    engine.submit(request(n=n, seed=2, mode="ticks", ticks=24,
+                          scenario="steady"))
+    engine.drain()
+
+
 EXERCISES: tuple[SurfaceExercise, ...] = (
     SurfaceExercise("dense", _prep_dense, _run_dense),
     SurfaceExercise("warp", _prep_warp, _run_warp),
     SurfaceExercise("fleet", _prep_fleet, _run_fleet),
+    SurfaceExercise("serve", _prep_serve, _run_serve),
 )
 
 
@@ -355,7 +393,8 @@ def write_surface(
     payload = {
         "comment": (
             "graftscan compile-surface budget: distinct XLA compilations per "
-            "entry-point family across the scripted dense+warp+fleet exercise "
+            "entry-point family across the scripted dense+warp+fleet+serve "
+            "exercise "
             "(fresh process — `python -m kaboodle_tpu.analysis --ir`). CI "
             "fails on growth; raising a count requires editing this file "
             "with a justification. Shrink when the measured count drops."

@@ -45,6 +45,13 @@ HOT_DIRS = (
     # shims over it, so a host sync or dtype drift HERE is one landing in
     # all five compiled program families at once.
     "kaboodle_tpu/phasegraph/",
+    # serve/: the resident service loop (ISSUE 10). pool.py/engine.py sit
+    # on the hot dispatch path of a LONG-RUNNING server — a host sync or
+    # eager indexing there is paid per round forever, and an untraced
+    # admission input mints programs until the zero-recompile contract is
+    # gone. The asyncio front end (server/client/loadgen/dryrun) is
+    # host-side by design; KB301's reachability scoping keeps it quiet.
+    "kaboodle_tpu/serve/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
@@ -75,6 +82,13 @@ DTYPE_DISCIPLINE_FILES = (
     # int16/int32 timers with sentinel wraparound, uint32 fingerprints —
     # and every parity pin in the tree compares THEIR outputs now.
     "exec.py", "blocked.py", "span.py",
+    # serve/: pool.py's traced lane vectors (int32 budgets/counters, bool
+    # masks) ride into the serve step every round — a promoted vector
+    # changes the program signature and re-compiles; engine.py's derive
+    # body (in phasegraph) and its k_m handoff carry the same discipline.
+    # (engine.py the FILENAME is already listed for oracle/; names match
+    # within HOT_DIRS, so serve/engine.py is covered by that entry.)
+    "pool.py",
 )
 
 _CONSTRUCTORS = {

@@ -81,6 +81,71 @@ def load(path, mesh=None) -> MeshState:
     return state
 
 
+def save_fleet(path, fleet, generation=None) -> None:
+    """Write a ``FleetState`` (the serve pool resident) to ``path`` (.npz).
+
+    One entry per stacked ``MeshState`` field (``mesh.`` prefixed) plus the
+    per-member ``drop_rate`` knob vector and — when given — the serve
+    pool's per-lane ``generation`` counters, so a restored pool resumes
+    with its (lane, generation) trajectory names intact. Same absent-if-
+    None convention as :func:`save`."""
+    arrays = {
+        "mesh." + f.name: np.asarray(getattr(fleet.mesh, f.name))
+        for f in dataclasses.fields(fleet.mesh)
+        if getattr(fleet.mesh, f.name) is not None
+    }
+    arrays["drop_rate"] = np.asarray(fleet.drop_rate)
+    if generation is not None:
+        arrays["generation"] = np.asarray(generation, dtype=np.int32)
+    np.savez(
+        path,
+        __version__=np.int32(_FORMAT_VERSION),
+        __fleet__=np.int32(1),
+        **arrays,
+    )
+
+
+def load_fleet(path):
+    """Read a fleet checkpoint back; returns ``(fleet, generation)``.
+
+    ``generation`` is ``None`` when the checkpoint was saved without lane
+    counters (a bare ensemble snapshot). Round-trips bit-exactly — the
+    serve admission parity contract survives a spill/restore of the whole
+    pool (tests/test_checkpoint.py)."""
+    from kaboodle_tpu.fleet.core import FleetState
+
+    with np.load(path) as z:
+        if "__version__" not in z.files:
+            raise KaboodleError("not a kaboodle checkpoint (no version entry)")
+        version = int(z["__version__"])
+        if version != _FORMAT_VERSION:
+            raise KaboodleError(f"unsupported checkpoint version {version}")
+        if "__fleet__" not in z.files:
+            raise KaboodleError(
+                "not a fleet checkpoint (single-mesh? use checkpoint.load)"
+            )
+        fields = {f.name for f in dataclasses.fields(MeshState)}
+        present = {
+            name[len("mesh."):] for name in z.files if name.startswith("mesh.")
+        }
+        missing = fields - present - _optional_fields()
+        if missing:
+            raise KaboodleError(f"checkpoint missing fields: {sorted(missing)}")
+        mesh = MeshState(
+            **{
+                name: jnp.asarray(z["mesh." + name]) if name in present else None
+                for name in fields
+            }
+        )
+        if "drop_rate" not in z.files:
+            raise KaboodleError("fleet checkpoint missing drop_rate")
+        fleet = FleetState(mesh=mesh, drop_rate=jnp.asarray(z["drop_rate"]))
+        generation = (
+            jnp.asarray(z["generation"]) if "generation" in z.files else None
+        )
+    return fleet, generation
+
+
 _ASYNC_CKPTR = None
 
 

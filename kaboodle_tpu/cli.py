@@ -426,6 +426,20 @@ def main(argv=None) -> int:
         from kaboodle_tpu.telemetry.summary import main as telemetry_main
 
         return telemetry_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # Gossip-as-a-service subcommand (serve/server.py): a resident
+        # lane-pool simulation server over JSON-over-TCP. ``--dryrun``
+        # routes to the in-process CI exercise.
+        from kaboodle_tpu.serve.server import main as serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "serve-load":
+        # Load driver for the serve server (serve/loadgen.py): closed+
+        # open-loop phases against an in-process server, banks
+        # BENCH_serve.json and gates on zero steady-phase compiles.
+        from kaboodle_tpu.serve.loadgen import main as loadgen_main
+
+        return loadgen_main(argv[1:])
     if argv and argv[0] == "phasegraph":
         # Derived-engine dryrun subcommand (phasegraph/dryrun.py): build
         # every engine the planner derives from the op graph at toy N,

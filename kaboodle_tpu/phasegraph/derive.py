@@ -43,7 +43,7 @@ from kaboodle_tpu.config import SwimConfig
 # enters through `kaboodle_tpu.phasegraph` first.
 
 # The engine names, for dryrun/docs enumeration.
-ENGINES = ("dense", "fused", "chunked", "sharded", "fleet", "warp")
+ENGINES = ("dense", "fused", "chunked", "sharded", "fleet", "warp", "serve")
 
 
 def make_dense_tick(
@@ -148,6 +148,150 @@ def make_sharded_tick(
     sharded_tick.graph = tick.graph
     sharded_tick.programs = tick.programs
     return sharded_tick
+
+
+@dataclasses.dataclass
+class ServeStepOut:
+    """Per-lane outputs of one serve step chunk (all leaves ``[E]``).
+
+    ``conv_tick`` is the ticks-run count at the lane's FIRST observed
+    fingerprint agreement (``-1`` until then) — for a converge-mode lane it
+    equals the ``ticks_run`` a standalone ``run_until_converged`` of the
+    same seed would report. ``counters`` is the chunk's per-lane
+    ``ProtocolCounters`` delta (``None`` unless the step was built with
+    ``telemetry=True``); frozen lanes contribute zero.
+    """
+
+    remaining: object  # int32 [E] tick budget left
+    ticks_run: object  # int32 [E] ticks executed since admission
+    conv_tick: object  # int32 [E] ticks_run at first agreement, -1 before
+    done: object  # bool [E] lane finished (converged or budget exhausted)
+    messages: object  # int32 [E] unicasts delivered this chunk
+    counters: object | None = None  # ProtocolCounters with [E] leaves
+
+
+# ServeStepOut crosses the jit boundary of the serve step program, so it
+# must be a pytree (registered AFTER the class body: the dataclass carries
+# an optional-None leaf like MeshState.latency).
+import jax.tree_util as _jtu  # noqa: E402
+
+_jtu.register_dataclass(
+    ServeStepOut,
+    data_fields=(
+        "remaining", "ticks_run", "conv_tick", "done", "messages", "counters"
+    ),
+    meta_fields=(),
+)
+
+
+def make_serve_step(
+    cfg: SwimConfig, chunk: int, faulty: bool = False, telemetry: bool = False
+) -> Callable:
+    """The serving engine's resident program: a masked fleet converge chunk.
+
+    ONE compiled program advances a whole lane pool up to ``chunk`` ticks:
+    every lane that is occupied (``active``), unfinished and within budget
+    ticks in lockstep through the vmapped fleet tick; everything else —
+    free lanes, freshly converged converge-mode lanes, exhausted budgets —
+    freezes bit-exactly via ``fleet.core.freeze_members``. The whole
+    admission surface is TRACED (per-lane drop knob, activity masks, tick
+    budgets, run counters), so the serving loop re-dispatches this one
+    program forever: admitting, retiring and re-seeding lanes never
+    recompiles (the zero-recompile-after-warmup contract, pinned by
+    tests/test_fuzz_parity.py and the serve dryrun).
+
+    Per-lane semantics (``until_conv`` selects the request mode):
+
+    - converge mode (``until_conv[e]``): the lane freezes at the end-of-tick
+      state where its fingerprints first agree — the exact
+      ``run_until_converged`` contract, so a lane admitted mid-flight is
+      bit-exact with the standalone run of the same seed (with
+      ``remaining`` as the ``max_ticks`` bound).
+    - horizon mode (``~until_conv[e]``): the lane runs exactly its budget
+      (convergence observed and recorded, not a stop condition) — the
+      ``simulate``/``run_warped`` contract.
+
+    Like the fleet tick it wraps, the build is full-program-only under vmap
+    and the per-lane ``drop_rate`` knob is inert unless ``faulty=True``.
+    ``telemetry=True`` derives from the telemetry-plane fleet tick and
+    accumulates each lane's exact ``ProtocolCounters`` over the ticks it
+    actually advanced (frozen ticks contribute zero).
+
+    Returns ``serve_step(mesh, drop_rate, active, until_conv, remaining,
+    ticks_run, conv_tick) -> (mesh, ServeStepOut)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import fleet_idle_inputs, freeze_members
+    from kaboodle_tpu.sim.runner import state_converged
+
+    if chunk < 1:
+        raise ValueError("serve step chunk must be >= 1")
+    vtick = make_fleet_tick(cfg, faulty=faulty, telemetry=telemetry)
+    vconv = jax.vmap(state_converged)
+
+    def serve_step(mesh, drop_rate, active, until_conv, remaining,
+                   ticks_run, conv_tick):
+        ensemble = active.shape[0]
+        n = mesh.state.shape[-1]
+        idle = fleet_idle_inputs(n, ensemble, drop_rate=drop_rate)
+        # Entry test, like the standalone converge loop: a converge-mode
+        # lane already at agreement freezes immediately with conv_tick ==
+        # ticks_run (0 for a freshly admitted converged-init lane).
+        conv0 = vconv(mesh)
+        conv_tick = jnp.where(
+            active & conv0 & (conv_tick < 0), ticks_run, conv_tick
+        )
+        done0 = (~active) | (until_conv & conv0) | (remaining <= 0)
+        messages0 = jnp.zeros((ensemble,), jnp.int32)
+        if telemetry:
+            from kaboodle_tpu.telemetry.counters import zero_counters
+
+            counters0 = jax.tree.map(
+                lambda z: jnp.broadcast_to(z, (ensemble,)), zero_counters()
+            )
+        else:
+            counters0 = None
+
+        def cond(carry):
+            done, i = carry[4], carry[7]
+            return jnp.any(~done) & (i < chunk)
+
+        def body(carry):
+            mesh, remaining, ticks_run, conv_tick, done, messages, ctr, i = carry
+            new, out = vtick(mesh, idle)
+            m = out.metrics if telemetry else out
+            adv = ~done
+            mesh = freeze_members(adv, mesh, new)
+            ticks_run = jnp.where(adv, ticks_run + 1, ticks_run)
+            remaining = jnp.where(adv, remaining - 1, remaining)
+            messages = messages + jnp.where(adv, m.messages_delivered, 0)
+            if telemetry:
+                ctr = jax.tree.map(
+                    lambda t, c: t + jnp.where(adv, c, 0).astype(t.dtype),
+                    ctr, out.counters,
+                )
+            conv_now = adv & m.converged
+            conv_tick = jnp.where(conv_now & (conv_tick < 0),
+                                  ticks_run, conv_tick)
+            done = done | (until_conv & conv_now) | (remaining <= 0)
+            return mesh, remaining, ticks_run, conv_tick, done, messages, ctr, i + 1
+
+        mesh, remaining, ticks_run, conv_tick, done, messages, ctr, _ = (
+            jax.lax.while_loop(
+                cond,
+                body,
+                (mesh, remaining, ticks_run, conv_tick, done0, messages0,
+                 counters0, jnp.int32(0)),
+            )
+        )
+        return mesh, ServeStepOut(
+            remaining=remaining, ticks_run=ticks_run, conv_tick=conv_tick,
+            done=done, messages=messages, counters=ctr,
+        )
+
+    return serve_step
 
 
 def make_warp_leap(

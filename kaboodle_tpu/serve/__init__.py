@@ -1,0 +1,30 @@
+"""serve — gossip-as-a-service: continuous-batching simulation serving.
+
+The ROADMAP's "heavy traffic from millions of users" demands a *runtime*,
+not another batch CLI: this package keeps one resident fixed-shape ``[E]``
+fleet per N-class and admits concurrent simulation requests into its free
+lanes MID-FLIGHT — a converged lane is frozen, harvested and re-seeded with
+the next request's seed/knobs without ever leaving the compiled step
+program (the whole admission surface is traced), so a warmed server
+compiles nothing, whatever the request mix does.
+
+Layers:
+
+- pool.py    — the lane pool: fixed-shape FleetState + per-lane generation
+               counters, on-device admit/retire/re-seed, pow2 N-classes.
+- engine.py  — the resident step loop: the phasegraph serve step (masked
+               converge chunks) composed with the per-member fleet warp
+               (quiescent horizon-mode lanes fast-forward, hot lanes tick
+               dense), plus request bookkeeping and lane spill.
+- server.py  — asyncio JSON-over-TCP front end (submit/status/cancel/
+               stream) streaming ``kaboodle-telemetry/1`` records live.
+- client.py  — the asyncio client + a one-shot synchronous helper.
+- loadgen.py — closed+open-loop load driver (BENCH_serve.json).
+- dryrun.py  — the CI lane: in-process server, toy requests, schema-checked
+               manifest, zero-fresh-compiles assertion.
+"""
+
+from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+from kaboodle_tpu.serve.pool import LanePool, lane_n_class
+
+__all__ = ["LanePool", "ServeEngine", "ServeRequest", "lane_n_class"]

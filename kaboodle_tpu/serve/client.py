@@ -1,0 +1,127 @@
+"""asyncio client for the serve server (plus a one-shot sync helper).
+
+One :class:`ServeClient` is one TCP connection running sequential
+request/response ops; :meth:`ServeClient.open_stream` opens a SECOND
+connection switched into live-event mode and yields manifest records as
+they arrive (the stream ack is awaited before returning, so records for
+work submitted after ``open_stream`` can never be missed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+
+class ServeStream:
+    """A live-event connection: async-iterate manifest records."""
+
+    def __init__(self, reader, writer) -> None:
+        self._reader = reader
+        self._writer = writer
+
+    def __aiter__(self) -> "ServeStream":
+        return self
+
+    async def __anext__(self) -> dict:
+        line = await self._reader.readline()
+        if not line:
+            raise StopAsyncIteration
+        return json.loads(line)
+
+    async def close(self) -> None:
+        self._writer.close()
+
+
+class ServeClient:
+    """Sequential JSON-over-TCP ops against a :class:`ServeServer`."""
+
+    def __init__(self, host: str, port: int, reader, writer) -> None:
+        self.host = host
+        self.port = port
+        self._reader = reader
+        self._writer = writer
+
+    @classmethod
+    async def connect(cls, host: str = "127.0.0.1", port: int = 7447):
+        reader, writer = await asyncio.open_connection(host, port)
+        return cls(host, port, reader, writer)
+
+    async def _rpc(self, **op) -> dict:
+        self._writer.write(json.dumps(op).encode() + b"\n")
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "server error"))
+        return resp
+
+    async def submit(self, n: int, **fields) -> int:
+        """Submit a request; returns its request id. Fields mirror
+        :class:`~kaboodle_tpu.serve.engine.ServeRequest` (seed, mode,
+        ticks, drop_rate, scenario, keep)."""
+        resp = await self._rpc(op="submit", n=n, **fields)
+        return resp["request_id"]
+
+    async def status(self, request_id: int | None = None):
+        resp = await self._rpc(op="status", request_id=request_id)
+        return resp["status"]
+
+    async def wait(self, request_id: int) -> dict:
+        """Block until the request is terminal; returns its status row
+        (the harvest result included)."""
+        resp = await self._rpc(op="wait", request_id=request_id)
+        return resp["status"]
+
+    async def cancel(self, request_id: int) -> bool:
+        resp = await self._rpc(op="cancel", request_id=request_id)
+        return resp["cancelled"]
+
+    async def restore(self, request_id: int) -> bool:
+        resp = await self._rpc(op="restore", request_id=request_id)
+        return resp["restored"]
+
+    async def resume(self, request_id: int, mode: str = "ticks",
+                     ticks: int = 16) -> None:
+        await self._rpc(op="resume", request_id=request_id, mode=mode,
+                        ticks=ticks)
+
+    async def stats(self) -> dict:
+        resp = await self._rpc(op="stats")
+        return resp["stats"]
+
+    async def shutdown(self) -> None:
+        self._writer.write(json.dumps({"op": "shutdown"}).encode() + b"\n")
+        await self._writer.drain()
+        await self._reader.readline()  # the bye ack
+
+    async def open_stream(self) -> ServeStream:
+        """A NEW connection in live-event mode (awaits the ack, so the
+        subscription is active before this returns)."""
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        writer.write(json.dumps({"op": "stream"}).encode() + b"\n")
+        await writer.drain()
+        ack = json.loads(await reader.readline())
+        if not ack.get("streaming"):
+            raise RuntimeError(f"stream handshake failed: {ack}")
+        return ServeStream(reader, writer)
+
+    async def close(self) -> None:
+        self._writer.close()
+
+
+def run_one(n: int, host: str = "127.0.0.1", port: int = 7447,
+            **fields) -> dict:
+    """Synchronous one-shot: connect, submit, wait, return the status row."""
+
+    async def go() -> dict:
+        client = await ServeClient.connect(host, port)
+        try:
+            rid = await client.submit(n, **fields)
+            return await client.wait(rid)
+        finally:
+            await client.close()
+
+    return asyncio.run(go())

@@ -1,0 +1,156 @@
+"""The serve CI lane: in-process server, toy requests, hard assertions.
+
+``make serve-dryrun`` (= ``python -m kaboodle_tpu serve --dryrun``) boots
+the full stack — engine over a 4-lane n=16 pool, asyncio TCP server on an
+ephemeral port, client + live stream connection — and drives 8 toy
+requests through it, asserting the three service contracts:
+
+1. **zero fresh compiles after warmup** over the ENTIRE exercise
+   (admissions, leap and chunk rounds, harvests, re-seeds, park, spill,
+   restore, resume, cancel) via the KB405 compile counter;
+2. **bit-exact service**: a converge-mode request's harvest matches a
+   standalone ``run_until_converged`` of the same (seed, scenario) —
+   conv_tick AND the final member state leaf-for-leaf;
+3. **streamed = written**: every manifest record seen live on the stream
+   connection also landed in the manifest file, and the file passes the
+   schema gate (``read_manifest(validate=True)``).
+
+Prints a one-line JSON tail for the CI log.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+
+_WAIT_S = 30.0  # outer bound for any single dryrun phase
+
+
+async def _exercise(report: dict) -> None:
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.engine import ServeEngine
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.serve.server import ServeServer
+    from kaboodle_tpu.sim.runner import run_until_converged
+    from kaboodle_tpu.sim.state import init_state
+    from kaboodle_tpu.telemetry.manifest import read_manifest
+
+    assert_counter_live()
+    cfg = SwimConfig(deterministic=True)
+    tmp = tempfile.mkdtemp(prefix="kaboodle-serve-dryrun-")
+    manifest_path = os.path.join(tmp, "manifest.jsonl")
+    pool = LanePool(16, 4, cfg=cfg, chunk=8)
+    engine = ServeEngine(
+        [pool], warp=True, max_leap=64, spill_after=2, spill_dir=tmp
+    )
+    server = ServeServer(engine, port=0, manifest_path=manifest_path)
+    engine.warmup()
+    await server.start()
+
+    client = await ServeClient.connect(port=server.port)
+    stream = await client.open_stream()
+    streamed: list[dict] = []
+
+    async def pump() -> None:
+        async for rec in stream:
+            streamed.append(rec)
+
+    pump_task = asyncio.create_task(pump())
+
+    with compile_counter() as box:
+        # 8 toy requests through the 4-lane pool: converge and horizon
+        # modes interleaved, so the drain mixes chunk rounds, leap rounds
+        # and mid-flight re-seeds of retired lanes.
+        rids = []
+        for i in range(8):
+            horizon = bool(i % 2)
+            rids.append(await client.submit(
+                16, seed=i,
+                mode="ticks" if horizon else "converge",
+                ticks=40,
+                scenario="steady" if horizon else "boot",
+                keep=(i == 0),
+            ))
+        rows = {}
+        for rid in rids:
+            rows[rid] = await asyncio.wait_for(client.wait(rid), _WAIT_S)
+
+        # keep=True parked its lane; ride the idle countdown into a spill,
+        # then restore + resume + cancel — the whole lifecycle, still
+        # under the compile counter.
+        kept = rids[0]
+
+        async def _await_state(rid: int, state: str) -> dict:
+            while True:
+                row = await client.status(rid)
+                if row["state"] == state:
+                    return row
+                await asyncio.sleep(0.01)
+
+        spilled = await asyncio.wait_for(_await_state(kept, "spilled"), _WAIT_S)
+        assert os.path.exists(spilled["spill_path"]), spilled
+        assert await client.restore(kept)
+        await client.resume(kept, mode="ticks", ticks=8)
+        await asyncio.wait_for(client.wait(kept), _WAIT_S)
+        await client.cancel(kept)
+    report["compiles_after_warmup"] = box.count
+
+    # -- contract 2: bit-exact service (harvest vs standalone run; the
+    # full member-state leaf equality is pinned in tests/test_serve.py) ----
+    probe = rows[rids[2]]  # a converge-mode request whose lane was re-seeded
+    from kaboodle_tpu.sim.runner import state_agreement
+
+    ref_state, ref_ticks, ref_conv = run_until_converged(
+        init_state(16, seed=2), cfg, max_ticks=40
+    )
+    _, ref_fp_min, ref_fp_max, ref_alive = state_agreement(ref_state)
+    assert probe["result"]["conv_tick"] == int(ref_ticks), (
+        probe["result"], int(ref_ticks))
+    assert probe["result"]["converged"] == bool(ref_conv)
+    assert probe["result"]["fp_min"] == int(ref_fp_min)
+    assert probe["result"]["fp_max"] == int(ref_fp_max)
+    assert probe["result"]["n_alive"] == int(ref_alive)
+    report["bitexact_conv_tick"] = True
+
+    stats = await client.stats()
+    report["rounds"] = stats["round"]
+    report["requests"] = stats["requests"]
+
+    await client.shutdown()
+    await server.close()
+    await asyncio.wait_for(pump_task, _WAIT_S)
+
+    # -- contract 3: streamed == written, schema-clean ----------------------
+    written = list(read_manifest(manifest_path, validate=True))
+    assert streamed, "stream connection saw no records"
+    assert len(written) == len(streamed) + 1, (  # +1: the pre-stream warm rec
+        len(written), len(streamed))
+    kinds = {}
+    for rec in written:
+        kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
+    assert kinds.get("serve_event", 0) >= 8 + 8  # admissions + completions
+    assert kinds.get("serve_round", 0) >= 1
+    events = {r.get("event") for r in written if r["kind"] == "serve_event"}
+    for needed in ("warm", "admitted", "converged", "completed", "spilled",
+                   "restored", "resumed", "cancelled"):
+        assert needed in events, (needed, sorted(events))
+    report["manifest_records"] = len(written)
+    report["streamed_records"] = len(streamed)
+    report["record_kinds"] = kinds
+
+    assert report["compiles_after_warmup"] == 0, report
+
+
+def run_dryrun() -> int:
+    report: dict = {"dryrun": "serve"}
+    asyncio.run(_exercise(report))
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0

@@ -1,0 +1,465 @@
+"""The resident step loop: requests in, convergence events out.
+
+One :class:`ServeEngine` owns a set of lane pools (one per pow2 N-class)
+and advances them all with a continuous-batching round loop:
+
+1. **admit** — queued requests drop into free lanes of their class pool
+   (one warmed reseed dispatch each; the retired occupant is overwritten
+   in place on device).
+2. **advance** — each pool with active lanes runs either ONE masked
+   fleet-leap dispatch (per-member horizons: every horizon-mode lane leaps
+   exactly its own ``k_m``, converge-mode and hot lanes freeze at
+   ``k_m == 0``) or one serve-step chunk (masked dense ticks, per-lane
+   convergence tests inside the compiled while_loop). The leap path is the
+   Warp 2.0 per-member round from ``run_fleet_warped``, re-used verbatim:
+   same signature fetch, same classifier, same bucketed ProgramCache.
+3. **harvest** — lanes whose run finished (converged under a converge-mode
+   budget, or horizon exhausted) are read out with one vmapped agreement
+   fetch, emitted as ``serve_event`` records, then parked or released.
+   Released lanes are immediately re-seedable: retire/re-seed never leaves
+   the warmed program set.
+4. **spill** — parked lanes idle past ``spill_after`` rounds are gathered
+   (traced-lane fetch) and written through ``checkpoint.save``; a later
+   ``restore`` inserts them back into a free lane of the same class.
+
+Correctness rules the loop enforces:
+
+- converge-mode lanes NEVER leap — a hybrid leap may jump past the first
+  fp-agreement tick, and the service contract is bit-exactness with a
+  standalone ``run_until_converged`` of the same (seed, knobs, scenario).
+  Only horizon-mode lanes (exact tick budgets) take the fast-forward.
+- leaps only in fault-free, non-telemetry pools (the span programs'
+  precondition; exact counter totals require dense ticks).
+- every per-round ``k_m`` is clamped to ``max_leap``, so only the leap
+  buckets warmed by :meth:`ServeEngine.warmup` are ever requested — leap
+  composition is exact, so the clamp costs dispatches, not correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.serve.pool import LanePool, lane_n_class
+from kaboodle_tpu.telemetry.manifest import run_record
+from kaboodle_tpu.warp.horizon import decode_signature
+from kaboodle_tpu.warp.runner import (
+    MIN_LEAP,
+    _classify,
+    _fleet_signature,
+    _get_fleet_leap,
+    _leap_budget,
+)
+
+# Request lifecycle states (the engine's host-side view of a lane).
+QUEUED = "queued"
+RUNNING = "running"
+PARKED = "parked"
+SPILLED = "spilled"
+DONE = "done"
+CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeRequest:
+    """One simulation request: scenario + knobs + N-class.
+
+    ``mode="converge"`` runs until fp-agreement (or ``ticks`` as the
+    budget cap) — the served twin of ``run_until_converged``, bit-exact
+    per the admission parity pin. ``mode="ticks"`` runs exactly ``ticks``
+    ticks (horizon mode) — the lane the warp fast-forward applies to.
+    ``keep=True`` parks the finished lane (spillable, resumable) instead
+    of releasing it."""
+
+    n: int
+    seed: int = 0
+    mode: str = "converge"
+    ticks: int = 64
+    drop_rate: float = 0.0
+    scenario: str = "boot"
+    keep: bool = False
+
+    def __post_init__(self):
+        if self.mode not in ("converge", "ticks"):
+            raise ValueError(f"unknown mode {self.mode!r}")
+        if self.ticks < 1:
+            raise ValueError("need ticks >= 1")
+
+    @property
+    def n_class(self) -> int:
+        return lane_n_class(self.n)
+
+    @property
+    def until_conv(self) -> bool:
+        return self.mode == "converge"
+
+
+class ServeEngine:
+    """Continuous-batching round loop over a dict of lane pools.
+
+    ``pools`` maps pow2 N-class -> :class:`LanePool`; requests are routed
+    by :func:`lane_n_class`. ``on_event`` (optional) is called with every
+    emitted manifest record as it happens — the server's live stream tap.
+    """
+
+    def __init__(
+        self,
+        pools,
+        warp: bool = True,
+        max_leap: int = 256,
+        spill_after: int | None = None,
+        spill_dir: str | None = None,
+        on_event=None,
+    ) -> None:
+        self.pools: dict[int, LanePool] = {}
+        for pool in pools:
+            if pool.n in self.pools:
+                raise ValueError(f"duplicate pool class n={pool.n}")
+            self.pools[pool.n] = pool
+        if not self.pools:
+            raise ValueError("need at least one pool")
+        self.warp = bool(warp)
+        self.max_leap = int(max_leap)
+        if self.max_leap < MIN_LEAP:
+            raise ValueError(f"need max_leap >= MIN_LEAP ({MIN_LEAP})")
+        self.spill_after = spill_after
+        self.spill_dir = spill_dir
+        self.on_event = on_event
+        self.round = 0
+        self._next_rid = 0
+        # rid -> bookkeeping row; insertion order is admission FIFO order.
+        self._requests: OrderedDict[int, dict] = OrderedDict()
+        # (n_class, lane) -> rid for lanes currently occupied by a request.
+        self._lane_owner: dict[tuple[int, int], int] = {}
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, req: ServeRequest) -> int:
+        """Queue a request; returns its request id. Raises on an unserved
+        N-class or a faulty knob no pool can honor — rejection is loud,
+        not an event."""
+        n_class = req.n_class
+        pool = self.pools.get(n_class)
+        if pool is None:
+            raise ValueError(
+                f"no pool serves N-class {n_class} (request n={req.n})"
+            )
+        if req.scenario not in ("boot", "steady"):
+            raise ValueError(f"unknown scenario {req.scenario!r}")
+        if req.drop_rate and not pool.faulty:
+            raise ValueError(
+                f"pool n={n_class} is fault-free; drop_rate must be 0"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._requests[rid] = {
+            "req": req,
+            "state": QUEUED,
+            "lane": None,
+            "pool": n_class,
+            "generation": None,
+            "result": None,
+            "idle_rounds": 0,
+            "spill_path": None,
+        }
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request in any non-terminal state; frees its lane."""
+        row = self._requests.get(rid)
+        if row is None or row["state"] in (DONE, CANCELLED):
+            return False
+        if row["state"] in (RUNNING, PARKED):
+            pool = self.pools[row["pool"]]
+            pool.release(row["lane"])
+            del self._lane_owner[(row["pool"], row["lane"])]
+            row["lane"] = None
+        row["state"] = CANCELLED
+        self._emit("serve_event", event="cancelled", request_id=rid,
+                   pool_n=row["pool"], lane=-1)
+        return True
+
+    def status(self, rid: int | None = None):
+        """One request's public row, or all of them (rid=None)."""
+        if rid is not None:
+            row = self._requests.get(rid)
+            return None if row is None else self._public_row(rid, row)
+        return [self._public_row(r, row) for r, row in self._requests.items()]
+
+    def _public_row(self, rid: int, row: dict) -> dict:
+        req = row["req"]
+        out = {
+            "request_id": rid,
+            "state": row["state"],
+            "n": req.n,
+            "n_class": row["pool"],
+            "seed": req.seed,
+            "mode": req.mode,
+            "lane": row["lane"],
+            "generation": row["generation"],
+        }
+        if row["result"] is not None:
+            out["result"] = dict(row["result"])
+        if row["spill_path"] is not None:
+            out["spill_path"] = row["spill_path"]
+        return out
+
+    # -- spill / restore ---------------------------------------------------
+
+    def _spill(self, rid: int, row: dict) -> None:
+        from kaboodle_tpu import checkpoint
+
+        pool = self.pools[row["pool"]]
+        lane = row["lane"]
+        path = os.path.join(
+            self.spill_dir, f"lane-n{row['pool']}-req{rid}.npz"
+        )
+        checkpoint.save(path, pool.member(lane))
+        pool.release(lane)
+        del self._lane_owner[(row["pool"], lane)]
+        row.update(state=SPILLED, lane=None, spill_path=path)
+        self._emit("serve_event", event="spilled", request_id=rid,
+                   pool_n=row["pool"], lane=lane, path=path)
+
+    def restore(self, rid: int) -> bool:
+        """Bring a spilled request back into a free lane (parked). Returns
+        False when its class pool has no free lane right now."""
+        from kaboodle_tpu import checkpoint
+
+        row = self._requests.get(rid)
+        if row is None or row["state"] != SPILLED:
+            raise ValueError(f"request {rid} is not spilled")
+        pool = self.pools[row["pool"]]
+        lane = pool.free_lane()
+        if lane is None:
+            return False
+        member = checkpoint.load(row["spill_path"])
+        row["generation"] = pool.insert(lane, member)
+        self._lane_owner[(row["pool"], lane)] = rid
+        row.update(state=PARKED, lane=lane, idle_rounds=0)
+        self._emit("serve_event", event="restored", request_id=rid,
+                   pool_n=row["pool"], lane=lane,
+                   generation=row["generation"])
+        return True
+
+    def resume(self, rid: int, mode: str = "ticks", ticks: int = 16) -> None:
+        """Re-activate a parked request with a fresh budget (continuation
+        runs across the park/spill boundary keep their tick counters)."""
+        row = self._requests.get(rid)
+        if row is None or row["state"] != PARKED:
+            raise ValueError(f"request {rid} is not parked")
+        if mode not in ("converge", "ticks"):
+            raise ValueError(f"unknown mode {mode!r}")
+        pool = self.pools[row["pool"]]
+        pool.resume(row["lane"], until_conv=(mode == "converge"),
+                    budget=int(ticks))
+        row["state"] = RUNNING
+        row["idle_rounds"] = 0
+        row["result"] = None  # the continuation's harvest replaces it
+        self._emit("serve_event", event="resumed", request_id=rid,
+                   pool_n=row["pool"], lane=row["lane"], mode=mode,
+                   ticks=int(ticks))
+
+    # -- the round loop ----------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        if any(row["state"] == QUEUED for row in self._requests.values()):
+            return True
+        return any(pool.active.any() for pool in self.pools.values())
+
+    def step(self) -> list[dict]:
+        """One engine round: admit, advance every pool, harvest, spill.
+
+        Returns the manifest records emitted this round (also fanned out
+        through ``on_event`` as they happen)."""
+        self._events: list[dict] = []
+        self._admit_queued()
+        for pool in self.pools.values():
+            if not pool.active.any():
+                continue
+            if not self._try_leap_round(pool):
+                self._chunk_round(pool)
+            self._harvest(pool)
+        self._spill_idle()
+        self.round += 1
+        return self._events
+
+    def drain(self, max_rounds: int = 10_000) -> list[dict]:
+        """Round until nothing is queued or active; returns all events."""
+        events: list[dict] = []
+        for _ in range(max_rounds):
+            if not self.busy:
+                return events
+            events.extend(self.step())
+        raise RuntimeError(f"engine still busy after {max_rounds} rounds")
+
+    def _admit_queued(self) -> None:
+        for rid, row in self._requests.items():
+            if row["state"] != QUEUED:
+                continue
+            pool = self.pools[row["pool"]]
+            lane = pool.free_lane()
+            if lane is None:
+                continue  # class full this round; stays queued (FIFO)
+            req: ServeRequest = row["req"]
+            row["generation"] = pool.admit(
+                lane, seed=req.seed, drop_rate=req.drop_rate,
+                until_conv=req.until_conv, budget=req.ticks,
+                scenario=req.scenario,
+            )
+            self._lane_owner[(row["pool"], lane)] = rid
+            row.update(state=RUNNING, lane=lane)
+            self._emit("serve_event", event="admitted", request_id=rid,
+                       pool_n=row["pool"], lane=lane,
+                       generation=row["generation"], seed=req.seed,
+                       mode=req.mode, scenario=req.scenario)
+
+    def _try_leap_round(self, pool: LanePool) -> bool:
+        """One masked fleet-leap dispatch if any horizon lane can cover
+        MIN_LEAP ticks; returns False when this round must run dense."""
+        if not self.warp or pool.faulty or pool.telemetry:
+            return False
+        horizon = pool.active & ~pool.until_conv & (pool.remaining > 0)
+        if not horizon.any():
+            return False
+        rows = np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
+        # int32 on the host: jnp.asarray is then a plain device put — an
+        # int64 vector would dispatch a fresh convert_element_type program
+        # and break the zero-recompile contract.
+        k_m = np.zeros((pool.lanes,), dtype=np.int32)
+        for e in np.flatnonzero(horizon):
+            cls = decode_signature(rows[e])
+            mode = _classify(cls, hybrid=True)
+            if mode != "dense":
+                k_m[e] = min(
+                    _leap_budget(cls, mode, int(pool.remaining[e])),
+                    self.max_leap,
+                )
+        if k_m.max() < MIN_LEAP:
+            return False
+        K = 1 << int(k_m.max() - 1).bit_length()
+        K = max(K, MIN_LEAP)
+        pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, jnp.asarray(k_m))
+        pool.advance_leaped(k_m)
+        self._emit(
+            "serve_round", round=self.round, pool_n=pool.n, engine="leap",
+            lanes=int((k_m > 0).sum()), ticks=int(k_m.sum()), bucket=K,
+        )
+        return True
+
+    def _chunk_round(self, pool: LanePool) -> None:
+        prev = pool.ticks_run.copy()
+        pool.step()
+        self._emit(
+            "serve_round", round=self.round, pool_n=pool.n, engine="chunk",
+            lanes=int(pool.active.sum()),
+            ticks=int((pool.ticks_run - prev).sum()),
+        )
+
+    def _harvest(self, pool: LanePool) -> None:
+        finished = pool.active & (
+            (pool.until_conv & (pool.conv_tick >= 0))
+            | (pool.remaining <= 0)
+        )
+        if not finished.any():
+            return
+        converged, fp_min, fp_max, n_alive = pool.agreement()
+        for lane in np.flatnonzero(finished):
+            lane = int(lane)
+            rid = self._lane_owner[(pool.n, lane)]
+            row = self._requests[rid]
+            req: ServeRequest = row["req"]
+            result = {
+                "ticks_run": int(pool.ticks_run[lane]),
+                "conv_tick": int(pool.conv_tick[lane]),
+                "converged": bool(converged[lane]),
+                "fp_min": int(fp_min[lane]),
+                "fp_max": int(fp_max[lane]),
+                "n_alive": int(n_alive[lane]),
+                "messages": int(pool.messages[lane]),
+            }
+            counters = pool.counters_row(lane)
+            if counters is not None:
+                result["counters"] = counters
+            row["result"] = result
+            if not pool.until_conv[lane]:
+                event = "completed"  # horizon run: ran its exact ticks
+            elif pool.conv_tick[lane] >= 0:
+                event = "converged"
+            else:
+                event = "exhausted"  # converge run: budget up, no agreement
+            self._emit(
+                "serve_event", event=event, request_id=rid, pool_n=pool.n,
+                lane=lane, generation=row["generation"], **result,
+            )
+            if req.keep:
+                pool.park(lane)
+                row["state"] = PARKED
+                row["idle_rounds"] = 0
+            else:
+                pool.release(lane)
+                del self._lane_owner[(pool.n, lane)]
+                row.update(state=DONE, lane=None)
+
+    def _spill_idle(self) -> None:
+        if self.spill_after is None or self.spill_dir is None:
+            return
+        for rid, row in self._requests.items():
+            if row["state"] != PARKED:
+                continue
+            row["idle_rounds"] += 1
+            if row["idle_rounds"] > self.spill_after:
+                self._spill(rid, row)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the whole serving surface with state-preserving
+        dispatches: each pool's program set (pool.warmup), then — for
+        pools the warp applies to — the signature fetch and every leap
+        bucket 8..max_leap at ``k_m = 0`` (the masked span program freezes
+        everyone bit-exactly at zero). After this the round loop's
+        admit/leap/chunk/harvest/spill path compiles nothing."""
+        for pool in self.pools.values():
+            pool.warmup()
+            if not self.warp or pool.faulty or pool.telemetry:
+                continue
+            np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
+            zeros = jnp.zeros((pool.lanes,), jnp.int32)
+            K = MIN_LEAP
+            while K <= self.max_leap:
+                pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, zeros)
+                K <<= 1
+        self._emit_standalone(
+            "serve_event", event="warm", request_id=-1, lane=-1,
+            pool_n=min(self.pools), pools=sorted(self.pools),
+        )
+
+    # -- events ------------------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> dict:
+        rec = self._emit_standalone(kind, **fields)
+        self._events.append(rec)
+        return rec
+
+    def _emit_standalone(self, kind: str, **fields) -> dict:
+        rec = run_record(kind, **fields)
+        if self.on_event is not None:
+            self.on_event(rec)
+        return rec
+
+    def stats(self) -> dict:
+        states: dict[str, int] = {}
+        for row in self._requests.values():
+            states[row["state"]] = states.get(row["state"], 0) + 1
+        return {
+            "round": self.round,
+            "requests": len(self._requests),
+            "states": states,
+            "pools": {n: p.stats() for n, p in self.pools.items()},
+        }

@@ -1,0 +1,210 @@
+"""Closed+open-loop load driver for the serve server (BENCH_serve.json).
+
+Drives a real in-process :class:`ServeServer` over TCP loopback — the
+measured path includes the wire protocol, the engine round loop and every
+device dispatch, exactly what a remote client would see minus network
+flight time.
+
+Two phases, both after a warmup wave that is excluded from measurement and
+from the compile gate:
+
+- **closed loop**: ``--concurrency`` workers each run submit→wait back to
+  back until the request budget is spent — the saturation throughput shape
+  (offered load adapts to service rate).
+- **open loop**: submissions arrive on a fixed schedule at ``--rate``
+  req/s regardless of completions — the latency-under-load shape (queueing
+  shows up in the tail instead of throttling the arrivals).
+
+Every completion latency is submit-to-harvest. The steady phase runs under
+the KB405 compile counter and the banked report pins ``compiles_steady ==
+0`` — the zero-recompile-after-warmup acceptance gate, measured on the
+serving path itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+def _mix_fields(i: int) -> dict:
+    """The load mix: converge-mode boots and horizon-mode steady runs
+    interleaved (the two service shapes; odd = warp-eligible)."""
+    if i % 2:
+        return {"seed": i, "mode": "ticks", "ticks": 40, "scenario": "steady"}
+    return {"seed": i, "mode": "converge", "ticks": 40, "scenario": "boot"}
+
+
+def _latency_stats(lat_s: list[float]) -> dict:
+    a = np.asarray(lat_s, dtype=np.float64) * 1e3
+    return {
+        "p50_ms": float(np.percentile(a, 50)),
+        "p99_ms": float(np.percentile(a, 99)),
+        "mean_ms": float(a.mean()),
+        "max_ms": float(a.max()),
+    }
+
+
+async def _closed_loop(client_factory, n: int, requests: int, concurrency: int):
+    lat: list[float] = []
+    issued = 0
+
+    async def worker(wid: int) -> None:
+        nonlocal issued
+        client = await client_factory()
+        try:
+            while True:
+                if issued >= requests:
+                    return
+                i = issued
+                issued += 1
+                t0 = time.perf_counter()
+                rid = await client.submit(n, **_mix_fields(i))
+                await client.wait(rid)
+                lat.append(time.perf_counter() - t0)
+        finally:
+            await client.close()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = time.perf_counter() - t0
+    return lat, elapsed
+
+
+async def _open_loop(client_factory, n: int, requests: int, rate: float):
+    lat: list[float] = []
+    client = await client_factory()
+    waiters: list[asyncio.Task] = []
+
+    async def complete(rid: int, t0: float) -> None:
+        # One wait op needs its own connection (the shared one is busy
+        # submitting on schedule).
+        c = await client_factory()
+        try:
+            await c.wait(rid)
+            lat.append(time.perf_counter() - t0)
+        finally:
+            await c.close()
+
+    start = time.perf_counter()
+    try:
+        for i in range(requests):
+            due = start + i / rate
+            delay = due - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            t0 = time.perf_counter()
+            rid = await client.submit(n, **_mix_fields(i))
+            waiters.append(asyncio.create_task(complete(rid, t0)))
+        await asyncio.gather(*waiters)
+    finally:
+        await client.close()
+    elapsed = time.perf_counter() - start
+    return lat, elapsed
+
+
+async def _run(args) -> dict:
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.engine import ServeEngine
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.serve.server import ServeServer
+
+    assert_counter_live()
+    pool = LanePool(args.n, args.lanes, chunk=args.chunk)
+    engine = ServeEngine([pool], warp=not args.no_warp, max_leap=args.max_leap)
+    server = ServeServer(engine, port=0)
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    await server.start()
+
+    async def client_factory():
+        return await ServeClient.connect(port=server.port)
+
+    # Warmup wave: one request per lane per mode shape, uncounted.
+    warm_client = await client_factory()
+    for i in range(2 * args.lanes):
+        rid = await warm_client.submit(args.n, **_mix_fields(i))
+        await warm_client.wait(rid)
+    await warm_client.close()
+
+    with compile_counter() as box:
+        closed_lat, closed_s = await _closed_loop(
+            client_factory, args.n, args.requests, args.concurrency
+        )
+        open_lat, open_s = await _open_loop(
+            client_factory, args.n, args.requests, args.rate
+        )
+    compiles = box.count
+
+    stats = None
+    probe = await client_factory()
+    stats = await probe.stats()
+    await probe.shutdown()
+    await server.close()
+
+    return {
+        "bench": "serve",
+        "n": args.n,
+        "lanes": args.lanes,
+        "chunk": args.chunk,
+        "warp": not args.no_warp,
+        "warmup_s": round(warmup_s, 3),
+        "compiles_steady": compiles,
+        "closed": {
+            "requests": len(closed_lat),
+            "concurrency": args.concurrency,
+            "elapsed_s": round(closed_s, 3),
+            "throughput_rps": round(len(closed_lat) / closed_s, 2),
+            "latency": _latency_stats(closed_lat),
+        },
+        "open": {
+            "requests": len(open_lat),
+            "offered_rps": args.rate,
+            "elapsed_s": round(open_s, 3),
+            "throughput_rps": round(len(open_lat) / open_s, 2),
+            "latency": _latency_stats(open_lat),
+        },
+        "engine_rounds": stats["round"],
+    }
+
+
+def main(argv=None) -> int:
+    """``python -m kaboodle_tpu serve-load`` — load-test the service."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="kaboodle-tpu serve-load",
+        description="closed+open-loop load driver over an in-process server",
+    )
+    parser.add_argument("--n", type=int, default=16, help="request N-class")
+    parser.add_argument("--lanes", type=int, default=8)
+    parser.add_argument("--chunk", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=64,
+                        help="measured requests per phase")
+    parser.add_argument("--concurrency", type=int, default=8,
+                        help="closed-loop workers")
+    parser.add_argument("--rate", type=float, default=50.0,
+                        help="open-loop offered req/s")
+    parser.add_argument("--max-leap", type=int, default=64)
+    parser.add_argument("--no-warp", action="store_true")
+    parser.add_argument("--out", default="BENCH_serve.json")
+    args = parser.parse_args(argv)
+
+    report = asyncio.run(_run(args))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report))
+    if report["compiles_steady"] != 0:
+        print(f"FAIL: {report['compiles_steady']} fresh compiles in the "
+              "steady phase (zero-recompile gate)")
+        return 1
+    return 0

@@ -1,0 +1,414 @@
+"""The lane pool: a resident fixed-shape ``[E]`` fleet served lane by lane.
+
+A pool owns one ``FleetState`` whose ensemble axis is reinterpreted as E
+*lanes*: independent request slots multiplexed through the one compiled
+serve step program (phasegraph/derive.py ``make_serve_step``). Everything a
+request varies — seed, drop knob, mode, tick budget — is TRACED, so the
+pool's whole lifecycle (admit, tick, retire, re-seed) re-dispatches the
+same warmed programs forever:
+
+- **re-seed** (:meth:`LanePool.admit`): a jitted scatter writes
+  ``init_state(n, seed)`` into lane ``e`` (both traced — one program for
+  every lane/seed/knob combination) and bumps the lane's on-device
+  generation counter, so lane ``e`` holds exactly the state a standalone
+  run of that seed would start from. Bit-exactness of the subsequent
+  trajectory is the fleet parity contract (fleet/core.py): the serve step
+  advances lanes through the same vmapped tick, freezing everything else.
+- **generation counters** (int32 ``[E]``, on device): bumped by every
+  re-seed/insert, checkpointed with the fleet (checkpoint.save_fleet), and
+  stamped into every harvest event — a lane's (index, generation) pair
+  names one served request's trajectory unambiguously across spills and
+  restores.
+- **N-classes**: requests are bucketed to power-of-two mesh sizes
+  (:func:`lane_n_class`) exactly like the warp ProgramCache's chunk
+  buckets — each pow2 class is one resident pool / one program family, so
+  arbitrary request sizes never mint fresh programs.
+
+The pool is deliberately host-bookkeeping-light: occupancy and per-lane
+run counters live as numpy vectors fed to (and fetched from) the step
+program each round; only the mesh, the drop knob vector and the generation
+counters are resident on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.fleet.core import FleetState, init_fleet
+from kaboodle_tpu.sim.runner import state_agreement
+from kaboodle_tpu.sim.state import init_state
+
+MIN_LANE_N = 8  # smallest served mesh class
+
+# Request "scenario" -> init_state shape kwargs (static per compiled reseed
+# program; both variants are warmed, so scenario choice never recompiles).
+# "boot": a fresh mesh that must gossip/broadcast its way to agreement.
+# "steady": a converged, already-announced mesh — the steady-state service
+# shape horizon-mode requests (and the warp fast-forward) start from.
+SCENARIOS = {
+    "boot": {},
+    "steady": lambda n: {"ring_contacts": n - 1, "announced": True},
+}
+
+
+def lane_n_class(n: int) -> int:
+    """The pow2 mesh-size class serving a request for ``n`` peers.
+
+    Mirrors the warp ProgramCache's power-of-two chunk vocabulary: one
+    resident pool (= one compiled program family) per class, whatever
+    sizes clients ask for. Requests run AT class size — the class is part
+    of the service contract (a request's standalone-equivalent run is the
+    class-sized one)."""
+    if n < 1:
+        raise ValueError("need n >= 1")
+    return max(MIN_LANE_N, 1 << (int(n) - 1).bit_length())
+
+
+def make_reseed_fn(n: int, scenario: str = "boot", **state_kwargs):
+    """The on-device retire/re-seed program (jit me with lane/seed traced).
+
+    ``reseed(mesh, generation, drop_rate, lane, seed, drop)`` scatters a
+    fresh ``init_state(n, seed)`` into lane ``lane`` of the stacked mesh,
+    bumps that lane's generation counter and sets its drop knob — all via
+    traced-index updates, so ONE compiled program re-seeds any lane with
+    any request. The written member is leaf-for-leaf what the standalone
+    init would build (same kwargs; the PRNG key is ``PRNGKey(seed)``
+    traced), which is what makes mid-flight admission bit-exact."""
+    shape_kw = SCENARIOS[scenario]
+    kw = dict(shape_kw(n) if callable(shape_kw) else shape_kw)
+    kw.update(state_kwargs)
+
+    def reseed(mesh, generation, drop_rate, lane, seed, drop):
+        fresh = init_state(n, seed=seed, **kw)
+        mesh = jax.tree.map(lambda leaf, f: leaf.at[lane].set(f), mesh, fresh)
+        generation = generation.at[lane].add(1)
+        drop_rate = drop_rate.at[lane].set(drop)
+        return mesh, generation, drop_rate
+
+    return reseed
+
+
+def make_insert_fn():
+    """Traced-lane member scatter: restore a spilled/checkpointed member.
+
+    Same contract as the reseed program but the member state comes from the
+    caller (checkpoint.load) instead of ``init_state`` — the restore half
+    of the lane spill path. Bumps the generation counter too: a restored
+    occupancy is a new generation of that lane."""
+
+    def insert(mesh, generation, lane, member):
+        mesh = jax.tree.map(lambda leaf, f: leaf.at[lane].set(f), mesh, member)
+        generation = generation.at[lane].add(1)
+        return mesh, generation
+
+    return insert
+
+
+@functools.lru_cache(maxsize=None)
+def _step_program(cfg, chunk: int, faulty: bool, telemetry: bool):
+    """The jitted serve step, shared process-wide: two pools of the same
+    (cfg, chunk, faulty, telemetry) signature — or a pool rebuilt after a
+    restore — reuse one compiled program instead of re-jitting."""
+    from kaboodle_tpu.phasegraph.derive import make_serve_step
+
+    return jax.jit(
+        make_serve_step(cfg, chunk, faulty=faulty, telemetry=telemetry)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _reseed_program(n: int, scenario: str, state_kwargs_items: tuple):
+    return jax.jit(
+        make_reseed_fn(n, scenario=scenario, **dict(state_kwargs_items))
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _insert_program():
+    return jax.jit(make_insert_fn())
+
+
+@functools.lru_cache(maxsize=None)
+def _agree_program():
+    return jax.jit(jax.vmap(state_agreement))
+
+
+@functools.lru_cache(maxsize=None)
+def _member_fetch():
+    """Traced-lane member gather (the spill path's read side): one compiled
+    program whatever lane is fetched — eager ``leaf[e]`` indexing would
+    mint one program per lane index and break the zero-recompile budget."""
+
+    def fetch(mesh, lane):
+        return jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, lane, 0, keepdims=False),
+            mesh,
+        )
+
+    return jax.jit(fetch)
+
+
+class LanePool:
+    """E lanes of one N-class: device state + the warmed program set.
+
+    Host-side per-lane run vectors (``active``, ``until_conv``,
+    ``remaining``, ``ticks_run``, ``conv_tick`` — numpy) ride into the
+    serve step as traced inputs and come back as its outputs; the mesh,
+    drop knobs and generation counters stay on device. ``occupied`` is the
+    host occupancy map (a lane can be occupied but inactive: parked).
+    """
+
+    def __init__(
+        self,
+        n: int,
+        lanes: int,
+        cfg: SwimConfig | None = None,
+        faulty: bool = False,
+        telemetry: bool = False,
+        chunk: int = 8,
+        **state_kwargs,
+    ) -> None:
+        if n != lane_n_class(n):
+            raise ValueError(
+                f"pool n={n} is not a pow2 lane class (use lane_n_class)"
+            )
+        if lanes < 1:
+            raise ValueError("need lanes >= 1")
+        self.n = n
+        self.lanes = lanes
+        self.cfg = cfg if cfg is not None else SwimConfig(deterministic=True)
+        self.faulty = faulty
+        self.telemetry = telemetry
+        self.chunk = int(chunk)
+        self.state_kwargs = dict(state_kwargs)
+
+        fleet = init_fleet(n, lanes, **self.state_kwargs)
+        self.mesh = fleet.mesh
+        self.drop = fleet.drop_rate
+        self.generation = jnp.zeros((lanes,), jnp.int32)
+
+        # Host-side per-lane run state (serve-step inputs/outputs).
+        self.occupied = np.zeros((lanes,), dtype=bool)
+        self.active = np.zeros((lanes,), dtype=bool)
+        self.until_conv = np.zeros((lanes,), dtype=bool)
+        self.remaining = np.zeros((lanes,), dtype=np.int32)
+        self.ticks_run = np.zeros((lanes,), dtype=np.int32)
+        self.conv_tick = np.full((lanes,), -1, dtype=np.int32)
+        # Accumulated per-lane observability (reset at admission): unicast
+        # deliveries always; full ProtocolCounters totals in telemetry mode.
+        # Both count densely executed ticks (a leaped span is event-free by
+        # construction — its closed-form ping/ack totals live in the warp
+        # telemetry path, not here).
+        self.messages = np.zeros((lanes,), dtype=np.int64)
+        self.counter_totals: dict[str, np.ndarray] | None = None
+        if telemetry:
+            from kaboodle_tpu.telemetry.counters import FIELDS
+
+            self.counter_totals = {
+                name: np.zeros((lanes,), dtype=np.int64) for name in FIELDS
+            }
+
+        # Program set, process-cached: state_kwargs must be hashable
+        # (init_state shape knobs — ints/bools), which the tuple() enforces.
+        kw_items = tuple(sorted(self.state_kwargs.items()))
+        self._step = _step_program(self.cfg, self.chunk, faulty, telemetry)
+        self._reseed = {
+            name: _reseed_program(n, name, kw_items) for name in SCENARIOS
+        }
+        self._insert = _insert_program()
+        self._agree = _agree_program()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def free_lane(self) -> int | None:
+        free = np.flatnonzero(~self.occupied)
+        return int(free[0]) if free.size else None
+
+    def admit(
+        self,
+        lane: int,
+        seed: int,
+        drop_rate: float = 0.0,
+        until_conv: bool = True,
+        budget: int = 64,
+        scenario: str = "boot",
+    ) -> int:
+        """Re-seed lane ``lane`` with a request; returns its new generation.
+
+        One dispatch of the warmed reseed program — the retired occupant's
+        state is overwritten in place on device. The lane starts active
+        with a fresh tick budget; its trajectory from here is bit-exact
+        with a standalone run of ``init_state(n, seed)`` under the same
+        knobs (pinned in tests/test_fleet.py and the admission fuzz)."""
+        if self.occupied[lane]:
+            raise ValueError(f"lane {lane} is occupied")
+        if drop_rate and not self.faulty:
+            raise ValueError(
+                "nonzero drop_rate needs a faulty=True pool (the fault-free "
+                "program compiles the knob out, silently ignoring it)"
+            )
+        self.mesh, self.generation, self.drop = self._reseed[scenario](
+            self.mesh, self.generation, self.drop,
+            jnp.int32(lane), jnp.int32(seed), jnp.float32(drop_rate),
+        )
+        self.occupied[lane] = True
+        self.active[lane] = True
+        self.until_conv[lane] = bool(until_conv)
+        self.remaining[lane] = int(budget)
+        self.ticks_run[lane] = 0
+        self.conv_tick[lane] = -1
+        self.messages[lane] = 0
+        if self.counter_totals is not None:
+            for col in self.counter_totals.values():
+                col[lane] = 0
+        return int(np.asarray(self.generation)[lane])
+
+    def insert(self, lane: int, member) -> int:
+        """Scatter a restored member state into a free lane (spill return).
+
+        The lane comes back PARKED (occupied, inactive): the caller decides
+        whether to resume it with a fresh budget via :meth:`resume`."""
+        if self.occupied[lane]:
+            raise ValueError(f"lane {lane} is occupied")
+        self.mesh, self.generation = self._insert(
+            self.mesh, self.generation, jnp.int32(lane), member
+        )
+        self.occupied[lane] = True
+        self.active[lane] = False
+        return int(np.asarray(self.generation)[lane])
+
+    def resume(self, lane: int, until_conv: bool, budget: int) -> None:
+        """Re-activate a parked lane with a fresh budget (run counters keep
+        accumulating across the park/spill boundary)."""
+        if not self.occupied[lane]:
+            raise ValueError(f"lane {lane} is free")
+        self.active[lane] = True
+        self.until_conv[lane] = bool(until_conv)
+        self.remaining[lane] = int(budget)
+
+    def park(self, lane: int) -> None:
+        self.active[lane] = False
+
+    def release(self, lane: int) -> None:
+        """Retire a lane: mark it free. The husk state stays resident (and
+        frozen — inactive lanes never advance) until the next re-seed
+        overwrites it."""
+        self.occupied[lane] = False
+        self.active[lane] = False
+
+    def member(self, lane: int):
+        """Lane ``lane``'s mesh as a standalone ``MeshState`` (device) via
+        the traced-lane gather — safe inside the zero-recompile phase."""
+        return _member_fetch()(self.mesh, jnp.int32(lane))
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self):
+        """One serve-step chunk dispatch; updates host run vectors.
+
+        Returns the fetched :class:`~kaboodle_tpu.phasegraph.derive.
+        ServeStepOut` as a numpy pytree (``done`` is the program's view —
+        mask with ``occupied & active`` for harvest decisions)."""
+        self.mesh, out = self._step(
+            self.mesh, self.drop, self.active, self.until_conv,
+            self.remaining, self.ticks_run, self.conv_tick,
+        )
+        out = jax.tree.map(np.asarray, out)
+        self.remaining = out.remaining.astype(np.int32)
+        self.ticks_run = out.ticks_run.astype(np.int32)
+        self.conv_tick = out.conv_tick.astype(np.int32)
+        self.messages += out.messages.astype(np.int64)
+        if self.counter_totals is not None and out.counters is not None:
+            for name, col in self.counter_totals.items():
+                col += np.asarray(getattr(out.counters, name), dtype=np.int64)
+        return out
+
+    def counters_row(self, lane: int) -> dict[str, int] | None:
+        """Lane ``lane``'s accumulated ProtocolCounters totals (telemetry
+        pools only), as a plain dict ready for a manifest record."""
+        if self.counter_totals is None:
+            return None
+        return {k: int(v[lane]) for k, v in self.counter_totals.items()}
+
+    def advance_leaped(self, k_m: np.ndarray) -> None:
+        """Account a leap round: per-lane budgets/counters move by ``k_m``
+        (the mesh itself was advanced by the masked fleet leap)."""
+        k = k_m.astype(np.int32)
+        self.remaining = self.remaining - k
+        self.ticks_run = self.ticks_run + k
+
+    def agreement(self):
+        """Vmapped end-state agreement rows ``(converged, fp_min, fp_max,
+        n_alive)`` — the harvest statistics fetch (one dispatch)."""
+        return tuple(np.asarray(x) for x in self._agree(self.mesh))
+
+    def fleet_state(self) -> FleetState:
+        """The resident as a ``FleetState`` (checkpoint.save_fleet input)."""
+        return FleetState(mesh=self.mesh, drop_rate=self.drop)
+
+    def load_fleet_state(self, fleet: FleetState, generation) -> None:
+        """Adopt a checkpointed resident (checkpoint.load_fleet output)."""
+        if fleet.n != self.n or fleet.ensemble != self.lanes:
+            raise ValueError(
+                f"checkpoint shape [{fleet.ensemble}]xN{fleet.n} != pool "
+                f"[{self.lanes}]xN{self.n}"
+            )
+        self.mesh = fleet.mesh
+        self.drop = fleet.drop_rate
+        self.generation = jnp.asarray(generation, dtype=jnp.int32)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the pool's whole program set with state-preserving
+        dispatches: the serve step with every lane inactive (the masked
+        while_loop exits at entry, mesh untouched), each reseed scenario on
+        lane 0 (lane 0 is free pre-admission; the husk is overwritten),
+        the insert program re-writing lane 0 with its own member state
+        (bit-identical), and the gather/agreement fetches. After this, the
+        serving loop's chunk/admit/harvest path compiles nothing."""
+        if self.occupied.any():
+            raise ValueError("warm up before admitting requests")
+        self.step()
+        for name in SCENARIOS:
+            self.mesh, self.generation, self.drop = self._reseed[name](
+                self.mesh, self.generation, self.drop,
+                jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+            )
+        member0 = self.member(0)
+        self.mesh, self.generation = self._insert(
+            self.mesh, self.generation, jnp.int32(0), member0
+        )
+        self.agreement()
+
+    def stats(self) -> dict:
+        return {
+            "n": self.n,
+            "lanes": self.lanes,
+            "occupied": int(self.occupied.sum()),
+            "active": int(self.active.sum()),
+            "faulty": self.faulty,
+            "telemetry": self.telemetry,
+            "chunk": self.chunk,
+            "generation": np.asarray(self.generation).tolist(),
+        }
+
+
+@dataclasses.dataclass
+class HarvestRow:
+    """One finished lane's harvest statistics (host-side, event material)."""
+
+    lane: int
+    generation: int
+    ticks_run: int
+    conv_tick: int
+    converged: bool
+    fp_min: int
+    fp_max: int
+    n_alive: int

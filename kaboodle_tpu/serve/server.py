@@ -1,0 +1,313 @@
+"""asyncio JSON-over-TCP front end for the serve engine.
+
+Wire protocol: newline-delimited JSON both ways. A client sends one op
+object per line and reads one response object per line:
+
+    {"op": "submit", "n": 16, "seed": 7, "mode": "converge", ...}
+    -> {"ok": true, "request_id": 0}
+
+Ops: ``submit`` / ``status`` / ``cancel`` / ``wait`` / ``restore`` /
+``resume`` / ``stats`` / ``stream`` / ``shutdown``. ``wait`` parks the
+response until the request reaches a terminal state (race-free completion
+latency for the load driver — no polling). ``stream`` switches the
+connection into live-event mode: every manifest record the engine emits
+from then on is written to it as its own JSONL line (the same
+``kaboodle-telemetry/1`` records the manifest file gets), until the client
+disconnects.
+
+The engine round loop runs as an asyncio task in the server process:
+requests wake it, idleness parks it on an event with a short timeout (so
+host-side lifecycle like spill countdowns still advances). Engine compute
+is dispatched inline on the event loop — rounds are single bounded-chunk
+device dispatches by construction, which is exactly what makes the service
+responsive without threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+
+from kaboodle_tpu.serve.engine import (
+    CANCELLED,
+    DONE,
+    PARKED,
+    ServeEngine,
+    ServeRequest,
+)
+from kaboodle_tpu.telemetry.manifest import ManifestWriter
+
+
+def _wait_done(row: dict) -> bool:
+    """``wait`` resolves when the submitter's answer is in: the run was
+    harvested (result present — a kept lane may already be parked or even
+    spilled by then), finished outright, or cancelled. A resumed
+    continuation clears the old result, so waiting on it blocks until ITS
+    harvest."""
+    return row["state"] in (DONE, CANCELLED) or row.get("result") is not None
+
+_SUBMIT_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(ServeRequest)
+)
+
+# How long an idle engine loop sleeps between lifecycle polls (spill
+# countdowns advance per poll; submissions interrupt it immediately).
+_IDLE_POLL_S = 0.02
+
+
+class ServeServer:
+    """One engine + one TCP listener + the live event fan-out."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        manifest_path: str | None = None,
+    ) -> None:
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.manifest = (
+            ManifestWriter(manifest_path, stream=True) if manifest_path else None
+        )
+        engine.on_event = self._on_event
+        self._subscribers: set[asyncio.Queue] = set()
+        self._waiters: dict[int, list[asyncio.Future]] = {}
+        self._wake = asyncio.Event()
+        self._closed = asyncio.Event()
+        self._server: asyncio.base_events.Server | None = None
+        self._loop_task: asyncio.Task | None = None
+
+    # -- event fan-out -----------------------------------------------------
+
+    def _on_event(self, rec: dict) -> None:
+        if self.manifest is not None:
+            self.manifest.write_record(rec)
+        for q in self._subscribers:
+            q.put_nowait(rec)
+
+    def _resolve_waiters(self) -> None:
+        for rid in list(self._waiters):
+            row = self.engine.status(rid)
+            if row is not None and _wait_done(row):
+                for fut in self._waiters.pop(rid):
+                    if not fut.done():
+                        fut.set_result(row)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._loop_task = asyncio.create_task(self._engine_loop())
+
+    async def serve_forever(self) -> None:
+        await self._closed.wait()
+
+    async def close(self) -> None:
+        self._closed.set()
+        self._wake.set()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._loop_task is not None:
+            await self._loop_task
+        for q in self._subscribers:
+            q.put_nowait(None)
+        for futs in self._waiters.values():
+            for fut in futs:
+                if not fut.done():
+                    fut.cancel()
+        self._waiters.clear()
+        if self.manifest is not None:
+            self.manifest.close()
+
+    async def _engine_loop(self) -> None:
+        while not self._closed.is_set():
+            if self.engine.busy:
+                self.engine.step()
+                self._resolve_waiters()
+                await asyncio.sleep(0)  # let connections progress
+                continue
+            self._resolve_waiters()
+            # Idle: park until a submit wakes us (short timeout so parked-
+            # lane spill countdowns keep ticking via engine.step()).
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), _IDLE_POLL_S)
+            except asyncio.TimeoutError:
+                pass
+            if (
+                not self.engine.busy
+                and self.engine.spill_after is not None
+                and any(
+                    row["state"] == PARKED for row in self.engine.status()
+                )
+            ):
+                self.engine.step()
+                self._resolve_waiters()
+
+    # -- connections -------------------------------------------------------
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while not self._closed.is_set():
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    op = json.loads(line)
+                    resp = await self._dispatch(op, writer)
+                except Exception as e:  # op errors are responses, not crashes
+                    resp = {"ok": False, "error": str(e)}
+                if resp is None:  # stream mode took the connection over
+                    return
+                writer.write(json.dumps(resp).encode() + b"\n")
+                await writer.drain()
+        finally:
+            writer.close()
+
+    async def _dispatch(self, op: dict, writer):
+        name = op.get("op")
+        if name == "submit":
+            kw = {k: op[k] for k in _SUBMIT_FIELDS if k in op}
+            rid = self.engine.submit(ServeRequest(**kw))
+            self._wake.set()
+            return {"ok": True, "request_id": rid}
+        if name == "status":
+            return {"ok": True, "status": self.engine.status(op.get("request_id"))}
+        if name == "cancel":
+            return {"ok": True, "cancelled": self.engine.cancel(op["request_id"])}
+        if name == "wait":
+            rid = int(op["request_id"])
+            row = self.engine.status(rid)
+            if row is None:
+                return {"ok": False, "error": f"unknown request {rid}"}
+            if not _wait_done(row):
+                fut = asyncio.get_running_loop().create_future()
+                self._waiters.setdefault(rid, []).append(fut)
+                row = await fut
+            return {"ok": True, "status": row}
+        if name == "restore":
+            ok = self.engine.restore(op["request_id"])
+            self._wake.set()
+            return {"ok": True, "restored": ok}
+        if name == "resume":
+            self.engine.resume(
+                op["request_id"],
+                mode=op.get("mode", "ticks"),
+                ticks=op.get("ticks", 16),
+            )
+            self._wake.set()
+            return {"ok": True}
+        if name == "stats":
+            return {"ok": True, "stats": self.engine.stats()}
+        if name == "stream":
+            await self._stream(writer)
+            return None
+        if name == "shutdown":
+            writer.write(json.dumps({"ok": True, "bye": True}).encode() + b"\n")
+            await writer.drain()
+            self._closed.set()
+            self._wake.set()
+            return None
+        return {"ok": False, "error": f"unknown op {name!r}"}
+
+    async def _stream(self, writer) -> None:
+        q: asyncio.Queue = asyncio.Queue()
+        self._subscribers.add(q)
+        # Ack so the subscriber KNOWS it is attached before it triggers the
+        # events it wants to see (no submit-vs-subscribe race).
+        writer.write(
+            json.dumps({"ok": True, "streaming": True}).encode() + b"\n"
+        )
+        await writer.drain()
+        try:
+            while True:
+                rec = await q.get()
+                if rec is None:  # server close sentinel
+                    break
+                writer.write(json.dumps(rec).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._subscribers.discard(q)
+
+
+def main(argv=None) -> int:
+    """``python -m kaboodle_tpu serve`` — run the service."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="kaboodle-tpu serve",
+        description="gossip-as-a-service: resident lane-pool simulation server",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7447)
+    parser.add_argument(
+        "--classes", default="16",
+        help="comma-separated pow2 N-classes to serve (one pool each)",
+    )
+    parser.add_argument("--lanes", type=int, default=8, help="lanes per pool")
+    parser.add_argument("--chunk", type=int, default=8,
+                        help="serve-step dense chunk length")
+    parser.add_argument("--max-leap", type=int, default=256)
+    parser.add_argument("--no-warp", action="store_true",
+                        help="disable horizon-lane fast-forward")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="per-lane protocol counter totals (disables warp)")
+    parser.add_argument("--manifest", default=None,
+                        help="stream manifest records to this JSONL path")
+    parser.add_argument("--spill-after", type=int, default=None,
+                        help="spill parked lanes idle this many rounds")
+    parser.add_argument("--spill-dir", default=None)
+    parser.add_argument("--dryrun", action="store_true",
+                        help="run the in-process CI exercise and exit")
+    args = parser.parse_args(argv)
+
+    if args.dryrun:
+        from kaboodle_tpu.serve.dryrun import run_dryrun
+
+        return run_dryrun()
+
+    from kaboodle_tpu.serve.pool import LanePool, lane_n_class
+
+    pools = []
+    for tok in args.classes.split(","):
+        n = int(tok)
+        if n != lane_n_class(n):
+            parser.error(f"--classes entry {n} is not a pow2 class >= 8")
+        pools.append(
+            LanePool(n, args.lanes, chunk=args.chunk,
+                     telemetry=args.telemetry)
+        )
+    engine = ServeEngine(
+        pools, warp=not args.no_warp, max_leap=args.max_leap,
+        spill_after=args.spill_after, spill_dir=args.spill_dir,
+    )
+
+    async def run() -> None:
+        server = ServeServer(
+            engine, host=args.host, port=args.port,
+            manifest_path=args.manifest,
+        )
+        print("warming up...", flush=True)
+        engine.warmup()
+        await server.start()
+        print(f"serving on {server.host}:{server.port} "
+              f"(classes {sorted(engine.pools)})", flush=True)
+        try:
+            await server.serve_forever()
+        finally:
+            await server.close()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0

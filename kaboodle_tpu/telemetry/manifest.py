@@ -61,6 +61,15 @@ def validate_record(rec) -> dict:
         raise ValueError("manifest record needs a non-empty string 'kind'")
     if kind == "tick" and not isinstance(rec.get("tick"), int):
         raise ValueError("'tick' records need an integer 'tick' index")
+    if kind == "serve_event":
+        if not isinstance(rec.get("event"), str) or not rec.get("event"):
+            raise ValueError(
+                "'serve_event' records need a non-empty string 'event'"
+            )
+        if not isinstance(rec.get("lane"), int):
+            raise ValueError("'serve_event' records need an integer 'lane'")
+    if kind == "serve_round" and not isinstance(rec.get("round"), int):
+        raise ValueError("'serve_round' records need an integer 'round'")
     return rec
 
 
@@ -76,18 +85,40 @@ class ManifestWriter:
     ``append=True`` opts into accumulation for writers that deliberately
     build a multi-record stream across processes (bench.py ``--manifest``
     appends one ``run`` record per lane invocation).
+
+    ``stream=True`` opts into line-buffered live mode: every record is
+    flushed to the file the moment it is written, so a client tailing the
+    manifest (the serve server's ``stream`` op, ``tail -f``) sees records
+    at event time rather than at close. Batch writers keep the default
+    block buffering — a bench run has no live readers.
     """
 
-    def __init__(self, path: str, append: bool = False) -> None:
+    def __init__(
+        self, path: str, append: bool = False, stream: bool = False
+    ) -> None:
         self.path = path
+        self.stream = bool(stream)
         self._f = open(path, "a" if append else "w")
         self.records_written = 0
 
     def write(self, kind: str = "run", **fields) -> dict:
-        rec = validate_record(run_record(kind, **fields))
+        return self.write_record(run_record(kind, **fields))
+
+    def write_record(self, rec: dict) -> dict:
+        """Validate and write an ALREADY-BUILT record (the serve engine
+        emits records through ``on_event`` fan-out; the server writes the
+        same dict it hands to stream subscribers)."""
+        rec = validate_record(rec)
         self._f.write(json.dumps(rec) + "\n")
         self.records_written += 1
+        if self.stream:
+            self._f.flush()
         return rec
+
+    def flush(self) -> None:
+        """Push buffered records to the file now (no-op cost in stream
+        mode, where every write already flushed)."""
+        self._f.flush()
 
     def write_tick_metrics(self, metrics, counters=None, ticks=None) -> int:
         """Stream stacked per-tick ``TickMetrics`` (and optionally stacked

@@ -70,6 +70,8 @@ def summarize(records: dict[str, list[dict]]) -> dict:
     runs: list[dict] = []
     ticks: list[dict] = []
     warp_spans: list[dict] = []
+    serve_events: list[dict] = []
+    serve_rounds: list[dict] = []
     for recs in records.values():
         for rec in recs:
             kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
@@ -79,6 +81,10 @@ def summarize(records: dict[str, list[dict]]) -> dict:
                 ticks.append(rec)
             elif rec["kind"] == "warp_spans":
                 warp_spans.append(rec)
+            elif rec["kind"] == "serve_event":
+                serve_events.append(rec)
+            elif rec["kind"] == "serve_round":
+                serve_rounds.append(rec)
     out: dict = {
         "metric": "telemetry_manifest_summary",
         "manifests": len(records),
@@ -104,6 +110,35 @@ def summarize(records: dict[str, list[dict]]) -> dict:
             for f in ("spans", "ticks", "dispatches"):
                 agg[f] += int(rec.get(f, 0))
         out["leap_classes"] = {str(k): v for k, v in sorted(classes.items())}
+    if serve_events or serve_rounds:
+        # Serve-lane aggregation: request lifecycle counts, completed-run
+        # tick stats, and per-engine round totals (chunk vs leap ticks —
+        # the continuous-batching split the PERF.md serving section cites).
+        by_event: dict[str, int] = {}
+        for rec in serve_events:
+            ev = rec.get("event", "?")
+            by_event[ev] = by_event.get(ev, 0) + 1
+        finished = [
+            r for r in serve_events
+            if r.get("event") in ("converged", "completed", "exhausted")
+        ]
+        engines: dict[str, dict] = {}
+        for rec in serve_rounds:
+            agg = engines.setdefault(
+                rec.get("engine", "?"), {"rounds": 0, "ticks": 0}
+            )
+            agg["rounds"] += 1
+            agg["ticks"] += int(rec.get("ticks", 0))
+        serve: dict = {"events": by_event, "round_engines": engines}
+        if finished:
+            tr = [int(r["ticks_run"]) for r in finished if "ticks_run" in r]
+            serve["finished"] = len(finished)
+            serve["converged"] = sum(
+                1 for r in finished if r.get("converged")
+            )
+            if tr:
+                serve["mean_ticks_run"] = round(sum(tr) / len(tr), 2)
+        out["serve"] = serve
     if ticks:
         ticks.sort(key=lambda r: r["tick"])
         totals = {
@@ -148,6 +183,13 @@ def main(argv=None) -> int:
         if "final_converged" in summary:
             print(f"  first_converged_tick={summary.get('first_converged_tick')}"
                   f" final_converged={summary.get('final_converged')}")
+
+    if "serve" in summary:
+        s = summary["serve"]
+        ev = ", ".join(f"{k}:{v}" for k, v in sorted(s["events"].items()))
+        print(f"  serve: {ev}")
+        for eng, agg in sorted(s["round_engines"].items()):
+            print(f"    {eng}: {agg['rounds']} rounds, {agg['ticks']} ticks")
 
     if args.trace:
         from kaboodle_tpu.telemetry.trace import write_chrome_trace

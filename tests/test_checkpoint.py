@@ -136,3 +136,68 @@ def test_version_and_field_guards(tmp_path):
     np.savez(truncated, __version__=np.int32(1), state=np.zeros((2, 2)))
     with pytest.raises(KaboodleError):
         checkpoint.load(truncated)
+
+
+def test_fleet_roundtrip_with_generation(tmp_path):
+    """ISSUE 10 satellite: a serve pool resident — FleetState + per-lane
+    generation counters — round-trips bit-exactly through save_fleet/
+    load_fleet, and a resumed fleet's trajectory matches an unbroken one."""
+    import numpy as np
+
+    from kaboodle_tpu.fleet.core import (
+        fleet_idle_inputs,
+        init_fleet,
+        simulate_fleet,
+    )
+
+    n, e, cfg = 16, 3, SwimConfig(deterministic=True)
+    fleet = init_fleet(n, e, drop_rates=jnp.array([0.0, 0.1, 0.2]))
+    inputs = fleet_idle_inputs(n, e, ticks=5)
+    mid, _ = simulate_fleet(fleet, inputs, cfg, faulty=True)
+    generation = jnp.array([4, 0, 7], dtype=jnp.int32)
+
+    path = tmp_path / "fleet.npz"
+    checkpoint.save_fleet(path, mid, generation)
+    restored, gen2 = checkpoint.load_fleet(path)
+    _states_equal(mid.mesh, restored.mesh)
+    assert jnp.array_equal(mid.drop_rate, restored.drop_rate)
+    assert gen2.dtype == jnp.int32
+    assert np.array_equal(np.asarray(gen2), [4, 0, 7])
+
+    unbroken, _ = simulate_fleet(mid, inputs, cfg, faulty=True)
+    resumed, _ = simulate_fleet(restored, inputs, cfg, faulty=True)
+    _states_equal(unbroken.mesh, resumed.mesh)
+
+
+def test_fleet_roundtrip_without_generation(tmp_path):
+    from kaboodle_tpu.fleet.core import init_fleet
+
+    fleet = init_fleet(8, 2)
+    path = tmp_path / "fleet.npz"
+    checkpoint.save_fleet(path, fleet)
+    restored, gen = checkpoint.load_fleet(path)
+    assert gen is None
+    _states_equal(fleet.mesh, restored.mesh)
+
+
+def test_fleet_checkpoint_guards(tmp_path):
+    import numpy as np
+
+    from kaboodle_tpu.fleet.core import init_fleet
+
+    # a single-mesh checkpoint is not a fleet checkpoint
+    single = tmp_path / "single.npz"
+    checkpoint.save(single, init_state(8, seed=0))
+    with pytest.raises(KaboodleError, match="not a fleet checkpoint"):
+        checkpoint.load_fleet(single)
+    # missing mesh fields are loud
+    bad = tmp_path / "bad.npz"
+    np.savez(bad, __version__=np.int32(1), __fleet__=np.int32(1),
+             drop_rate=np.zeros((2,), np.float32))
+    with pytest.raises(KaboodleError, match="missing fields"):
+        checkpoint.load_fleet(bad)
+    # lane spill uses the single-mesh path: a fleet file is not a MeshState
+    fleet_path = tmp_path / "fleet.npz"
+    checkpoint.save_fleet(fleet_path, init_fleet(8, 2))
+    with pytest.raises(KaboodleError, match="missing fields"):
+        checkpoint.load(fleet_path)

@@ -406,3 +406,34 @@ def test_fleet_graftlint_clean():
     findings = [f for p in sorted(root.glob("*.py")) for f in analyze_path(p)]
     bad = [f for f in findings if f.rule.startswith(("KB2", "KB3"))]
     assert not bad, [(f.path, f.rule, f.line, f.message) for f in bad]
+
+
+def test_serve_admission_reseed_is_standalone_init():
+    """ISSUE 10 admission pin: the serve pool's traced-lane re-seed scatter
+    writes EXACTLY ``init_state(n, seed, **scenario_kwargs)`` into the lane
+    — leaf for leaf, both scenarios — so the fleet parity contract (member
+    k bit-exact with a standalone run) extends to lanes admitted mid-
+    flight. The full-trajectory pin lives in tests/test_serve.py."""
+    from kaboodle_tpu.serve.pool import SCENARIOS, LanePool
+
+    pool = LanePool(16, 2, cfg=SwimConfig(deterministic=True), chunk=4)
+    for lane, (scenario, seed) in enumerate(
+        (("boot", 41), ("steady", 42))
+    ):
+        gen = pool.admit(lane, seed=seed, scenario=scenario)
+        assert gen == 1  # fresh pool: first occupancy of this lane
+        shape_kw = SCENARIOS[scenario]
+        kw = dict(shape_kw(16) if callable(shape_kw) else shape_kw)
+        ref = init_state(16, seed=seed, **kw)
+        member = pool.member(lane)
+        for f in dataclasses.fields(ref):
+            a, b = getattr(member, f.name), getattr(ref, f.name)
+            if a is None or b is None:
+                assert a is None and b is None, f.name
+                continue
+            a, b = np.asarray(a), np.asarray(b)
+            eq = np.issubdtype(a.dtype, np.floating)
+            assert np.array_equal(a, b, equal_nan=eq), (
+                f"admitted lane {lane} leaf {f.name!r} != standalone "
+                f"init_state({scenario})"
+            )

@@ -547,3 +547,81 @@ def test_random_scenario_chunked_third_engine(seed):
             else:
                 ok = (xv == yv).all()
             assert ok, f"chunked mismatch at tick {t} (seed {seed})"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_admission_schedule_serve_arm(seed):
+    """ISSUE 10 fuzz arm: a RANDOM continuous-batching schedule — random
+    interleavings of admissions (random seed/mode/scenario/budget),
+    harvests, retire/re-seeds into recycled lanes and cancellations across
+    a small lane pool, with the warp on — must (a) harvest every
+    converge-mode request bit-exact with a standalone
+    ``run_until_converged`` of its (seed, scenario), (b) run every
+    horizon-mode request for exactly its budget, and (c) compile NOTHING
+    after warmup, whatever order the schedule drew."""
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.sim.runner import run_until_converged, state_agreement
+
+    assert_counter_live()
+    rng = np.random.default_rng(3000 + seed)
+    n = 16
+    cfg = SwimConfig(deterministic=True)
+    engine = ServeEngine(
+        [LanePool(n, 3, cfg=cfg, chunk=4)], warp=True, max_leap=16
+    )
+    engine.warmup()
+
+    plans: dict[int, ServeRequest] = {}
+    pending = 10
+    cancelled: set[int] = set()
+    with compile_counter() as box:
+        while pending or engine.busy:
+            burst = int(rng.integers(0, 3))
+            for _ in range(min(burst, pending)):
+                horizon = bool(rng.integers(2))
+                req = ServeRequest(
+                    n=n,
+                    seed=int(rng.integers(0, 50)),
+                    mode="ticks" if horizon else "converge",
+                    ticks=int(rng.integers(8, 48)),
+                    scenario="steady" if rng.integers(2) else "boot",
+                )
+                plans[engine.submit(req)] = req
+                pending -= 1
+            if plans and rng.integers(8) == 0:
+                victim = int(rng.choice(list(plans)))
+                if engine.cancel(victim):
+                    cancelled.add(victim)
+            engine.step()
+    assert box.count == 0, (
+        f"schedule seed {seed}: {box.count} fresh compilations after warmup"
+    )
+
+    finished = 0
+    for rid, req in plans.items():
+        row = engine.status(rid)
+        if rid in cancelled:
+            assert row["state"] == "cancelled"
+            continue
+        assert row["state"] == "done", (rid, row)
+        res = row["result"]
+        finished += 1
+        if req.mode == "ticks":
+            assert res["ticks_run"] == req.ticks, (rid, req, res)
+            continue
+        kw = {} if req.scenario == "boot" else {
+            "ring_contacts": n - 1, "announced": True}
+        ref_state, ref_ticks, ref_conv = run_until_converged(
+            init_state(n, seed=req.seed, **kw), cfg, max_ticks=req.ticks
+        )
+        conv, fp_min, fp_max, n_alive = state_agreement(ref_state)
+        assert res["conv_tick"] == int(ref_ticks), (rid, req, res)
+        assert res["converged"] == bool(ref_conv)
+        assert res["fp_min"] == int(fp_min) and res["fp_max"] == int(fp_max)
+        assert res["n_alive"] == int(n_alive)
+    assert finished > 0  # the schedule actually served something

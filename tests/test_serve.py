@@ -1,0 +1,459 @@
+"""Serve subsystem (ISSUE 10): admission bit-exactness, lifecycle, warp
+parity, spill/restore continuation, zero-recompile pin, server e2e, lint.
+
+The service contract under test: a request admitted into a lane of the
+resident pool — even mid-flight, while other lanes are running — produces
+EXACTLY the trajectory a standalone ``run_until_converged`` of the same
+(seed, knobs, scenario) would, and the whole lifecycle (admit, advance,
+harvest, re-seed, park, spill, restore, resume, cancel) re-dispatches the
+warmed program set without ever compiling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+from kaboodle_tpu.serve.pool import (
+    MIN_LANE_N,
+    LanePool,
+    lane_n_class,
+)
+from kaboodle_tpu.sim.runner import run_until_converged, state_agreement
+from kaboodle_tpu.sim.state import init_state
+
+CFG = SwimConfig(deterministic=True)
+N = 16  # one shared N-class: every pool below reuses one compiled set
+
+
+def _pool(lanes: int = 3, **kw) -> LanePool:
+    return LanePool(N, lanes, cfg=CFG, chunk=4, **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        # equal_nan: the latency leaf is NaN until a ping round-trips
+        eq = np.issubdtype(x.dtype, np.floating)
+        if not np.array_equal(x, y, equal_nan=eq):
+            return False
+    return True
+
+
+def _standalone(seed: int, scenario: str = "boot", max_ticks: int = 64):
+    kw = {} if scenario == "boot" else {"ring_contacts": N - 1,
+                                        "announced": True}
+    state, ticks, conv = run_until_converged(
+        init_state(N, seed=seed, **kw), CFG, max_ticks=max_ticks
+    )
+    return state, int(ticks), bool(conv)
+
+
+# -- classes and validation -------------------------------------------------
+
+
+def test_lane_n_class():
+    assert lane_n_class(1) == MIN_LANE_N
+    assert lane_n_class(MIN_LANE_N) == MIN_LANE_N
+    assert lane_n_class(9) == 16
+    assert lane_n_class(16) == 16
+    assert lane_n_class(17) == 32
+    with pytest.raises(ValueError, match="n >= 1"):
+        lane_n_class(0)
+    with pytest.raises(ValueError, match="pow2 lane class"):
+        LanePool(12, 2, cfg=CFG)
+    with pytest.raises(ValueError, match="lanes >= 1"):
+        LanePool(N, 0, cfg=CFG)
+
+
+def test_request_and_engine_validation():
+    with pytest.raises(ValueError, match="unknown mode"):
+        ServeRequest(n=16, mode="forever")
+    with pytest.raises(ValueError, match="ticks >= 1"):
+        ServeRequest(n=16, ticks=0)
+    assert ServeRequest(n=9).n_class == 16
+    assert ServeRequest(n=16).until_conv
+    assert not ServeRequest(n=16, mode="ticks").until_conv
+
+    pool = _pool(lanes=1)
+    with pytest.raises(ValueError, match="at least one pool"):
+        ServeEngine([])
+    with pytest.raises(ValueError, match="duplicate pool"):
+        ServeEngine([pool, _pool(lanes=1)])
+    with pytest.raises(ValueError, match="max_leap"):
+        ServeEngine([pool], max_leap=4)
+    eng = ServeEngine([pool], warp=False)
+    with pytest.raises(ValueError, match="no pool serves"):
+        eng.submit(ServeRequest(n=64))
+    with pytest.raises(ValueError, match="unknown scenario"):
+        eng.submit(ServeRequest(n=16, scenario="chaos"))
+    with pytest.raises(ValueError, match="fault-free"):
+        eng.submit(ServeRequest(n=16, drop_rate=0.5))
+
+
+def test_pool_lifecycle_guards_and_generations():
+    pool = _pool(lanes=2)
+    assert np.asarray(pool.generation).tolist() == [0, 0]
+    g = pool.admit(0, seed=1)
+    assert g == 1
+    with pytest.raises(ValueError, match="occupied"):
+        pool.admit(0, seed=2)
+    with pytest.raises(ValueError, match="faulty=True"):
+        pool.admit(1, seed=2, drop_rate=0.25)
+    with pytest.raises(ValueError, match="is free"):
+        pool.resume(1, until_conv=False, budget=4)
+    with pytest.raises(ValueError, match="warm up before"):
+        pool.warmup()
+    pool.release(0)
+    assert pool.admit(0, seed=3) == 2  # generations survive retire/re-seed
+    member = pool.member(0)
+    pool.release(0)
+    assert pool.insert(0, member) == 3  # insert bumps the counter too
+    assert pool.free_lane() == 1
+
+
+# -- the headline pin: mid-flight admission is bit-exact --------------------
+
+
+def test_admission_mid_flight_bit_exact():
+    """A lane admitted while another lane is mid-flight converges to the
+    leaf-for-leaf SAME state, at the same tick, as a standalone
+    ``run_until_converged`` of its (seed, scenario) — the service
+    contract that makes the resident pool a simulator, not a sampler."""
+    engine = ServeEngine([_pool(lanes=3)], warp=False)
+    r0 = engine.submit(ServeRequest(n=N, seed=5, keep=True))
+    engine.step()  # r0 admitted and mid-flight before r1 exists
+    r1 = engine.submit(ServeRequest(n=N, seed=11, keep=True))
+    r2 = engine.submit(ServeRequest(n=N, seed=7, scenario="steady",
+                                    keep=True))
+    engine.drain()
+
+    for rid, seed, scenario in ((r0, 5, "boot"), (r1, 11, "boot"),
+                                (r2, 7, "steady")):
+        row = engine.status(rid)
+        assert row["state"] == "parked"  # keep=True: member still resident
+        ref_state, ref_ticks, ref_conv = _standalone(seed, scenario)
+        res = row["result"]
+        assert res["conv_tick"] == ref_ticks, (rid, res, ref_ticks)
+        assert res["converged"] == ref_conv
+        conv, fp_min, fp_max, n_alive = state_agreement(ref_state)
+        assert res["fp_min"] == int(fp_min)
+        assert res["fp_max"] == int(fp_max)
+        assert res["n_alive"] == int(n_alive)
+        pool = engine.pools[N]
+        assert _leaves_equal(pool.member(row["lane"]), ref_state), (
+            f"request {rid} (seed {seed}, {scenario}) diverged from its "
+            "standalone run"
+        )
+
+
+def test_retire_reseed_cycle_stays_exact():
+    """The second wave through RECYCLED lanes (husk states overwritten by
+    the re-seed scatter) is as exact as the first."""
+    engine = ServeEngine([_pool(lanes=2)], warp=False)
+    first = [engine.submit(ServeRequest(n=N, seed=s)) for s in (0, 1)]
+    engine.drain()
+    second = [engine.submit(ServeRequest(n=N, seed=s, keep=True))
+              for s in (21, 22)]
+    engine.drain()
+    for rid in first:
+        assert engine.status(rid)["state"] == "done"
+    for rid, seed in zip(second, (21, 22)):
+        row = engine.status(rid)
+        _, ref_ticks, _ = _standalone(seed)
+        assert row["result"]["conv_tick"] == ref_ticks
+        ref_state, _, _ = _standalone(seed)
+        assert _leaves_equal(engine.pools[N].member(row["lane"]), ref_state)
+
+
+# -- warp composition -------------------------------------------------------
+
+
+def test_horizon_warp_parity():
+    """A horizon-mode request served with the fleet warp ON is bit-exact
+    with the same request served dense — and the warp engine actually
+    leaps (otherwise this pin is vacuous)."""
+    results = {}
+    for warp in (False, True):
+        engine = ServeEngine([_pool(lanes=2)], warp=warp, max_leap=16)
+        if warp:
+            engine.warmup()
+        rid = engine.submit(ServeRequest(n=N, seed=9, mode="ticks",
+                                         ticks=40, scenario="steady",
+                                         keep=True))
+        events = engine.drain()
+        row = engine.status(rid)
+        assert row["result"]["ticks_run"] == 40
+        results[warp] = engine.pools[N].member(row["lane"])
+        if warp:
+            leaps = [e for e in events if e["kind"] == "serve_round"
+                     and e["engine"] == "leap"]
+            assert leaps, "warp engine never leaped a quiescent horizon run"
+            assert all(e["bucket"] <= 16 for e in leaps)  # max_leap clamp
+    assert _leaves_equal(results[False], results[True]), (
+        "fleet-warp serving diverged from dense serving"
+    )
+
+
+def test_converge_mode_never_leaps():
+    """Converge-mode lanes must run dense even under a warp engine — a
+    hybrid leap may skip the first fp-agreement tick."""
+    engine = ServeEngine([_pool(lanes=2)], warp=True, max_leap=16)
+    engine.warmup()
+    rid = engine.submit(ServeRequest(n=N, seed=3, scenario="steady"))
+    events = engine.drain()
+    assert not [e for e in events if e["kind"] == "serve_round"
+                and e["engine"] == "leap"]
+    _, ref_ticks, _ = _standalone(3, "steady")
+    assert engine.status(rid)["result"]["conv_tick"] == ref_ticks
+
+
+# -- spill / restore continuation -------------------------------------------
+
+
+def test_spill_restore_continuation_bit_exact(tmp_path):
+    """A horizon run interrupted by park -> spill (checkpoint.save) ->
+    restore (checkpoint.load + insert) -> resume lands leaf-for-leaf on
+    the state of the same run served without the interruption."""
+    straight = ServeEngine([_pool(lanes=1)], warp=False)
+    rid = straight.submit(ServeRequest(n=N, seed=13, mode="ticks",
+                                       ticks=40, scenario="steady",
+                                       keep=True))
+    straight.drain()
+    want = straight.pools[N].member(straight.status(rid)["lane"])
+
+    # spill_after=2: the harvested lane must stay resident through the
+    # final drain round (spill_after=0 would re-spill it immediately).
+    engine = ServeEngine([_pool(lanes=1)], warp=False, spill_after=2,
+                         spill_dir=str(tmp_path))
+    rid = engine.submit(ServeRequest(n=N, seed=13, mode="ticks",
+                                     ticks=24, scenario="steady",
+                                     keep=True))
+    engine.drain()
+    while engine.status(rid)["state"] != "spilled":
+        engine.step()  # idle rounds tick the parked lane into the spill
+    path = engine.status(rid)["spill_path"]
+    assert os.path.exists(path)
+    assert engine.restore(rid)
+    assert engine.status(rid)["state"] == "parked"
+    engine.resume(rid, mode="ticks", ticks=16)  # 24 + 16 == 40
+    engine.drain()
+    row = engine.status(rid)
+    assert row["result"]["ticks_run"] == 40  # counters span the boundary
+    assert _leaves_equal(engine.pools[N].member(row["lane"]), want), (
+        "spill/restore continuation diverged from the uninterrupted run"
+    )
+    with pytest.raises(ValueError, match="not spilled"):
+        engine.restore(rid)
+
+
+# -- the zero-recompile pin -------------------------------------------------
+
+
+def test_zero_recompile_after_warmup(tmp_path):
+    """After ``ServeEngine.warmup`` the whole lifecycle — mixed admissions,
+    leap and chunk rounds, harvests, re-seeds into recycled lanes, park,
+    spill, restore, resume, cancel — compiles NOTHING (KB405 counter)."""
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+
+    assert_counter_live()
+    engine = ServeEngine([_pool(lanes=2)], warp=True, max_leap=16,
+                         spill_after=0, spill_dir=str(tmp_path))
+    engine.warmup()
+    with compile_counter() as box:
+        rids = [
+            engine.submit(ServeRequest(n=N, seed=0, keep=True)),
+            engine.submit(ServeRequest(n=N, seed=1, mode="ticks",
+                                       ticks=24, scenario="steady")),
+            engine.submit(ServeRequest(n=N, seed=2)),  # recycled lane
+        ]
+        engine.drain()
+        kept = rids[0]
+        while engine.status(kept)["state"] != "spilled":
+            engine.step()
+        assert engine.restore(kept)
+        engine.resume(kept, mode="ticks", ticks=8)
+        engine.drain()
+        assert engine.cancel(kept)
+        assert not engine.cancel(kept)  # already terminal
+    assert box.count == 0, (
+        f"{box.count} fresh compilations after warmup — the zero-recompile "
+        "service contract regressed"
+    )
+
+
+# -- telemetry pools --------------------------------------------------------
+
+
+def test_telemetry_pool_counters():
+    """A telemetry pool harvests full ProtocolCounters totals per lane —
+    and is excluded from the warp (exact totals need dense ticks)."""
+    from kaboodle_tpu.telemetry.counters import FIELDS
+
+    engine = ServeEngine([_pool(lanes=2, telemetry=True)], warp=True,
+                         max_leap=16)
+    r0 = engine.submit(ServeRequest(n=N, seed=4))
+    r1 = engine.submit(ServeRequest(n=N, seed=4, mode="ticks", ticks=16,
+                                    scenario="steady"))
+    events = engine.drain()
+    assert not [e for e in events if e["kind"] == "serve_round"
+                and e["engine"] == "leap"]
+    res0 = engine.status(r0)["result"]
+    assert set(res0["counters"]) == set(FIELDS)
+    assert res0["messages"] > 0
+    # The horizon run covers enough steady-state ticks for ping traffic.
+    res1 = engine.status(r1)["result"]
+    assert res1["counters"]["pings_sent"] > 0
+    # Same seed, same class: a second engine run of the same request
+    # reproduces the counter totals exactly (they are program outputs,
+    # not host samples).
+    engine2 = ServeEngine([_pool(lanes=2, telemetry=True)], warp=False)
+    r0b = engine2.submit(ServeRequest(n=N, seed=4))
+    engine2.drain()
+    assert engine2.status(r0b)["result"]["counters"] == res0["counters"]
+    assert engine2.status(r0b)["result"]["messages"] == res0["messages"]
+
+
+# -- server / client / manifest ---------------------------------------------
+
+
+def test_server_client_e2e(tmp_path):
+    """Submit/wait/status/stream/cancel/shutdown over real TCP, with the
+    manifest fan-out validating every record as it is written."""
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.server import ServeServer
+    from kaboodle_tpu.telemetry.manifest import read_manifest
+
+    manifest = str(tmp_path / "serve-manifest.jsonl")
+    engine = ServeEngine([_pool(lanes=2)], warp=False)
+    server = ServeServer(engine, port=0, manifest_path=manifest)
+    engine.warmup()
+
+    async def drive() -> None:
+        await server.start()
+        client = await ServeClient.connect(port=server.port)
+        stream = await client.open_stream()
+        streamed: list[dict] = []
+
+        async def pump() -> None:
+            async for rec in stream:
+                streamed.append(rec)
+
+        pump_task = asyncio.create_task(pump())
+        r0 = await client.submit(N, seed=6)
+        r1 = await client.submit(N, seed=8, mode="ticks", ticks=12,
+                                 scenario="steady")
+        row0 = await asyncio.wait_for(client.wait(r0), 30.0)
+        row1 = await asyncio.wait_for(client.wait(r1), 30.0)
+        _, ref_ticks, _ = _standalone(6)
+        assert row0["result"]["conv_tick"] == ref_ticks
+        assert row1["result"]["ticks_run"] == 12
+        assert not await client.cancel(r0)  # already done
+        stats = await client.stats()
+        assert stats["requests"] == 2
+        assert stats["states"].get("done") == 2
+        with pytest.raises(RuntimeError, match="no pool serves"):
+            await client.submit(64)
+        await client.shutdown()
+        await server.close()
+        await asyncio.wait_for(pump_task, 30.0)
+        assert streamed and all(
+            rec["schema"] == "kaboodle-telemetry/1" for rec in streamed
+        )
+
+    asyncio.run(drive())
+    written = list(read_manifest(manifest, validate=True))
+    events = {r.get("event") for r in written if r["kind"] == "serve_event"}
+    assert {"warm", "admitted", "converged", "completed"} <= events
+
+
+def test_manifest_stream_mode_and_serve_schema(tmp_path):
+    """``stream=True`` makes records durable per write (a concurrent
+    reader sees them before close), and the serve_* kinds are schema-
+    checked on the way in."""
+    from kaboodle_tpu.telemetry.manifest import (
+        ManifestWriter,
+        read_manifest,
+        run_record,
+        validate_record,
+    )
+
+    path = str(tmp_path / "stream.jsonl")
+    w = ManifestWriter(path, stream=True)
+    w.write_record(run_record("serve_event", event="admitted", lane=0))
+    w.write_record(run_record("serve_round", round=3, engine="chunk"))
+    live = list(read_manifest(path, validate=True))  # BEFORE close
+    assert [r["kind"] for r in live] == ["serve_event", "serve_round"]
+    with pytest.raises(ValueError, match="'lane'"):
+        w.write_record(run_record("serve_event", event="admitted"))
+    with pytest.raises(ValueError, match="'round'"):
+        w.write_record(run_record("serve_round", engine="chunk"))
+    with pytest.raises(ValueError, match="'event'"):
+        validate_record({"schema": "kaboodle-telemetry/1",
+                         "kind": "serve_event", "lane": 0})
+    w.close()
+    assert len(list(read_manifest(path, validate=True))) == 2
+
+
+def test_summary_aggregates_serve_records(tmp_path):
+    """``kaboodle telemetry`` folds serve_event/serve_round records into a
+    lifecycle + per-engine-rounds summary."""
+    from kaboodle_tpu.telemetry.manifest import ManifestWriter
+    from kaboodle_tpu.telemetry.summary import load_manifests, summarize
+
+    path = str(tmp_path / "m.jsonl")
+    engine = ServeEngine([_pool(lanes=2)], warp=False)
+    w = ManifestWriter(path)
+    engine.on_event = w.write_record
+    engine.submit(ServeRequest(n=N, seed=0))
+    engine.submit(ServeRequest(n=N, seed=1, mode="ticks", ticks=8,
+                               scenario="steady"))
+    engine.drain()
+    w.close()
+    out = summarize(load_manifests([path]))
+    serve = out["serve"]
+    assert serve["events"]["admitted"] == 2
+    assert serve["events"]["converged"] == 1
+    assert serve["events"]["completed"] == 1
+    assert serve["finished"] == 2
+    assert serve["round_engines"]["chunk"]["rounds"] >= 1
+    assert serve["round_engines"]["chunk"]["ticks"] > 0
+    assert json.dumps(out)  # summary stays JSON-serializable
+
+
+# -- lint scope -------------------------------------------------------------
+
+
+def test_serve_graftlint_clean():
+    """ISSUE 10 satellite: serve/ is in the hot-path lint scope (pool.py
+    under dtype discipline) and carries no KB2xx/KB3xx debt."""
+    from pathlib import Path
+
+    from kaboodle_tpu.analysis import analyze_path
+    from kaboodle_tpu.analysis.core import _load_rules
+    from kaboodle_tpu.analysis.rules_hotpath import (
+        DTYPE_DISCIPLINE_FILES,
+        HOT_DIRS,
+    )
+
+    assert "kaboodle_tpu/serve/" in HOT_DIRS
+    assert "pool.py" in DTYPE_DISCIPLINE_FILES
+    assert "engine.py" in DTYPE_DISCIPLINE_FILES
+    _load_rules()
+    root = Path(__file__).resolve().parent.parent / "kaboodle_tpu" / "serve"
+    findings = [f for p in sorted(root.glob("*.py")) for f in analyze_path(p)]
+    bad = [f for f in findings if f.rule.startswith(("KB2", "KB3"))]
+    assert not bad, [(f.path, f.rule, f.line, f.message) for f in bad]

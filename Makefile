@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -64,6 +64,7 @@ ci: lint native test
 	$(MAKE) telemetry-dryrun
 	$(MAKE) phasegraph-dryrun
 	$(MAKE) serve-dryrun
+	$(MAKE) serve-chaos-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -129,6 +130,21 @@ phasegraph-dryrun:
 # (PERF.md "Serving", BENCH_serve.json); CI only proves the contracts.
 serve-dryrun:
 	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --dryrun
+
+# Chaos harness (servefort, ISSUE 12): six deterministic fault-injection
+# scenarios against the hardened serving stack — async-spill round-latency
+# A/B vs the sync baseline, kill-mid-round journal recovery (bit-exact
+# continuations, no duplicate completions, zero fresh compiles), injected
+# spill-write failure with loud degrade + retry, corrupt spill file ->
+# structured restore error with the service intact, a stalled stream
+# consumer bounded by counted stream_gap drops, and a 10x pipelined submit
+# flood against admission control (queue_full + retry-after, priority
+# shedding, quota throttling, goodput under SLO). Each scenario asserts
+# its invariant from the inside; the overload CURVES are banked separately
+# by `python -m kaboodle_tpu serve-load --overload`
+# (PERF.md "Serving under overload", BENCH_serve_overload.json).
+serve-chaos-dryrun:
+	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --chaos-dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

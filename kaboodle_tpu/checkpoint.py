@@ -23,13 +23,16 @@ Two paths:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
+import zipfile
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kaboodle_tpu.errors import KaboodleError
+from kaboodle_tpu.errors import CheckpointError
 from kaboodle_tpu.sim.state import MeshState
 
 _FORMAT_VERSION = 1
@@ -40,34 +43,83 @@ def _optional_fields() -> set[str]:
     return {f.name for f in dataclasses.fields(MeshState) if f.default is None}
 
 
-def save(path, state: MeshState) -> None:
+@contextlib.contextmanager
+def _open_npz(path):
+    """``np.load`` with the failure modes a long-running service actually
+    meets — file missing, truncated mid-write, not a zip at all, a zip with
+    a corrupt member — normalized to :class:`CheckpointError` instead of
+    the raw ``FileNotFoundError``/``zipfile.BadZipFile``/``EOFError`` zoo,
+    so one bad restore degrades one request, not the host loop."""
+    try:
+        z = np.load(path)
+    except FileNotFoundError as e:
+        raise CheckpointError(f"checkpoint missing: {path}") from e
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError) as e:
+        raise CheckpointError(f"checkpoint unreadable ({e}): {path}") from e
+    try:
+        with z:
+            yield z
+    except CheckpointError:
+        raise
+    except (zipfile.BadZipFile, OSError, EOFError, KeyError, ValueError) as e:
+        # A member that decompresses short / CRC-fails surfaces HERE, on
+        # the array read, not at open time.
+        raise CheckpointError(f"checkpoint corrupt ({e}): {path}") from e
+
+
+def _savez_atomic(path, arrays: dict) -> None:
+    """fsync-then-rename npz write: a reader (or a crash-recovery restore)
+    sees either the complete previous file or the complete new one, never
+    a truncated archive."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save(path, state: MeshState, atomic: bool = False) -> None:
     """Write ``state`` to ``path`` (.npz), host-fetching device arrays.
 
     Optional fields that are ``None`` (the memory-lean ``track_latency=False``
     / ``instant_identity=True`` states) are simply absent from the archive —
-    never pickled as object arrays, which ``load`` could not read back."""
+    never pickled as object arrays, which ``load`` could not read back.
+    ``atomic=True`` writes through a same-directory temp file with
+    fsync-then-rename (the serve spill path's durability contract)."""
     arrays = {
         f.name: np.asarray(getattr(state, f.name))
         for f in dataclasses.fields(state)
         if getattr(state, f.name) is not None
     }
-    np.savez(path, __version__=np.int32(_FORMAT_VERSION), **arrays)
+    arrays["__version__"] = np.int32(_FORMAT_VERSION)
+    if atomic:
+        _savez_atomic(path, arrays)
+    else:
+        np.savez(path, **arrays)
 
 
 def load(path, mesh=None) -> MeshState:
     """Read a checkpoint; with ``mesh`` set, place rows across its devices
     (the layout kaboodle_tpu.parallel.shard_state would give a fresh state).
-    Optional fields absent from the archive restore as ``None``."""
-    with np.load(path) as z:
+    Optional fields absent from the archive restore as ``None``. All failure
+    modes — missing / truncated / corrupt file, wrong marker, missing
+    entries — raise :class:`CheckpointError`."""
+    with _open_npz(path) as z:
         if "__version__" not in z.files:
-            raise KaboodleError("not a kaboodle checkpoint (no version entry)")
+            raise CheckpointError(
+                f"not a kaboodle checkpoint (no version entry): {path}"
+            )
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
-            raise KaboodleError(f"unsupported checkpoint version {version}")
+            raise CheckpointError(f"unsupported checkpoint version {version}")
         fields = {f.name for f in dataclasses.fields(MeshState)}
         missing = fields - set(z.files) - _optional_fields()
         if missing:
-            raise KaboodleError(f"checkpoint missing fields: {sorted(missing)}")
+            raise CheckpointError(
+                f"checkpoint missing fields: {sorted(missing)}"
+            )
         state = MeshState(
             **{
                 name: jnp.asarray(z[name]) if name in z.files else None
@@ -81,14 +133,15 @@ def load(path, mesh=None) -> MeshState:
     return state
 
 
-def save_fleet(path, fleet, generation=None) -> None:
+def save_fleet(path, fleet, generation=None, atomic: bool = False) -> None:
     """Write a ``FleetState`` (the serve pool resident) to ``path`` (.npz).
 
     One entry per stacked ``MeshState`` field (``mesh.`` prefixed) plus the
     per-member ``drop_rate`` knob vector and — when given — the serve
     pool's per-lane ``generation`` counters, so a restored pool resumes
     with its (lane, generation) trajectory names intact. Same absent-if-
-    None convention as :func:`save`."""
+    None convention as :func:`save`; ``atomic=True`` gets the same
+    fsync-then-rename durability."""
     arrays = {
         "mesh." + f.name: np.asarray(getattr(fleet.mesh, f.name))
         for f in dataclasses.fields(fleet.mesh)
@@ -97,12 +150,12 @@ def save_fleet(path, fleet, generation=None) -> None:
     arrays["drop_rate"] = np.asarray(fleet.drop_rate)
     if generation is not None:
         arrays["generation"] = np.asarray(generation, dtype=np.int32)
-    np.savez(
-        path,
-        __version__=np.int32(_FORMAT_VERSION),
-        __fleet__=np.int32(1),
-        **arrays,
-    )
+    arrays["__version__"] = np.int32(_FORMAT_VERSION)
+    arrays["__fleet__"] = np.int32(1)
+    if atomic:
+        _savez_atomic(path, arrays)
+    else:
+        np.savez(path, **arrays)
 
 
 def load_fleet(path):
@@ -114,14 +167,16 @@ def load_fleet(path):
     pool (tests/test_checkpoint.py)."""
     from kaboodle_tpu.fleet.core import FleetState
 
-    with np.load(path) as z:
+    with _open_npz(path) as z:
         if "__version__" not in z.files:
-            raise KaboodleError("not a kaboodle checkpoint (no version entry)")
+            raise CheckpointError(
+                f"not a kaboodle checkpoint (no version entry): {path}"
+            )
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
-            raise KaboodleError(f"unsupported checkpoint version {version}")
+            raise CheckpointError(f"unsupported checkpoint version {version}")
         if "__fleet__" not in z.files:
-            raise KaboodleError(
+            raise CheckpointError(
                 "not a fleet checkpoint (single-mesh? use checkpoint.load)"
             )
         fields = {f.name for f in dataclasses.fields(MeshState)}
@@ -130,7 +185,9 @@ def load_fleet(path):
         }
         missing = fields - present - _optional_fields()
         if missing:
-            raise KaboodleError(f"checkpoint missing fields: {sorted(missing)}")
+            raise CheckpointError(
+                f"checkpoint missing fields: {sorted(missing)}"
+            )
         mesh = MeshState(
             **{
                 name: jnp.asarray(z["mesh." + name]) if name in present else None
@@ -138,7 +195,7 @@ def load_fleet(path):
             }
         )
         if "drop_rate" not in z.files:
-            raise KaboodleError("fleet checkpoint missing drop_rate")
+            raise CheckpointError("fleet checkpoint missing drop_rate")
         fleet = FleetState(mesh=mesh, drop_rate=jnp.asarray(z["drop_rate"]))
         generation = (
             jnp.asarray(z["generation"]) if "generation" in z.files else None

@@ -37,3 +37,11 @@ class StoppingFailed(KaboodleError):
 class ConvergenceTimeout(KaboodleError):
     """Simulator-specific (no reference equivalent): a bounded convergence
     drive ended without fingerprint agreement."""
+
+
+class CheckpointError(KaboodleError):
+    """A checkpoint file could not be read back as a kaboodle checkpoint —
+    missing, truncated, not a zip/npz at all, wrong marker, or missing
+    entries. Raised instead of the raw ``zipfile``/``KeyError``/``OSError``
+    so callers (the serve restore path above all) can degrade a single
+    request instead of tearing down the host loop."""

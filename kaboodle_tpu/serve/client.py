@@ -5,12 +5,32 @@ request/response ops; :meth:`ServeClient.open_stream` opens a SECOND
 connection switched into live-event mode and yields manifest records as
 they arrive (the stream ack is awaited before returning, so records for
 work submitted after ``open_stream`` can never be missed).
+
+Failure surface: a server-side op error raises :class:`ServeError`
+carrying the structured ``kind`` (``queue_full`` / ``quota`` /
+``bad_request`` / ``checkpoint`` / ``internal``) and ``retry_after_s``
+when the server supplied one. Every op takes ``timeout=`` seconds
+(None = unbounded) and raises a clean :class:`TimeoutError` — after
+which THIS connection is desynchronized (a late response may still be in
+flight) and refuses further ops; open a fresh client. ``submit`` can
+retry ``queue_full``/``quota`` rejections with backoff honoring the
+server's retry-after.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+
+
+class ServeError(RuntimeError):
+    """A structured op failure from the server."""
+
+    def __init__(self, message: str, kind: str = "internal",
+                 retry_after_s: float = 0.0) -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.retry_after_s = float(retry_after_s)
 
 
 class ServeStream:
@@ -41,55 +61,103 @@ class ServeClient:
         self.port = port
         self._reader = reader
         self._writer = writer
+        self._desynced = False
 
     @classmethod
     async def connect(cls, host: str = "127.0.0.1", port: int = 7447):
         reader, writer = await asyncio.open_connection(host, port)
         return cls(host, port, reader, writer)
 
-    async def _rpc(self, **op) -> dict:
+    async def _rpc(self, timeout: float | None = None, **op) -> dict:
+        if self._desynced:
+            raise ConnectionError(
+                "connection desynchronized by an earlier timeout; reconnect"
+            )
         self._writer.write(json.dumps(op).encode() + b"\n")
         await self._writer.drain()
-        line = await self._reader.readline()
+        try:
+            if timeout is None:
+                line = await self._reader.readline()
+            else:
+                line = await asyncio.wait_for(
+                    self._reader.readline(), timeout
+                )
+        except asyncio.TimeoutError:
+            # The response (if any) is still in flight; this connection's
+            # request/response pairing is broken from here on.
+            self._desynced = True
+            raise TimeoutError(
+                f"op {op.get('op')!r} timed out after {timeout}s"
+            ) from None
         if not line:
             raise ConnectionError("server closed the connection")
         resp = json.loads(line)
         if not resp.get("ok"):
-            raise RuntimeError(resp.get("error", "server error"))
+            raise ServeError(
+                resp.get("error", "server error"),
+                kind=resp.get("kind", "internal"),
+                retry_after_s=resp.get("retry_after_s", 0.0),
+            )
         return resp
 
-    async def submit(self, n: int, **fields) -> int:
+    async def submit(self, n: int, timeout: float | None = None,
+                     retries: int = 0, backoff: float = 0.05,
+                     **fields) -> int:
         """Submit a request; returns its request id. Fields mirror
         :class:`~kaboodle_tpu.serve.engine.ServeRequest` (seed, mode,
-        ticks, drop_rate, scenario, keep)."""
-        resp = await self._rpc(op="submit", n=n, **fields)
-        return resp["request_id"]
+        ticks, drop_rate, scenario, keep, tenant, priority).
 
-    async def status(self, request_id: int | None = None):
-        resp = await self._rpc(op="status", request_id=request_id)
+        ``retries`` re-attempts ``queue_full``/``quota`` rejections,
+        sleeping the server's ``retry_after_s`` when it gave one (else
+        exponential ``backoff`` doublings) — other error kinds re-raise
+        immediately; retrying a ``bad_request`` would never succeed."""
+        attempt = 0
+        while True:
+            try:
+                resp = await self._rpc(timeout=timeout, op="submit", n=n,
+                                       **fields)
+                return resp["request_id"]
+            except ServeError as e:
+                if e.kind not in ("queue_full", "quota") or attempt >= retries:
+                    raise
+                delay = e.retry_after_s or backoff * (2 ** attempt)
+                attempt += 1
+                await asyncio.sleep(delay)
+
+    async def status(self, request_id: int | None = None,
+                     timeout: float | None = None):
+        resp = await self._rpc(timeout=timeout, op="status",
+                               request_id=request_id)
         return resp["status"]
 
-    async def wait(self, request_id: int) -> dict:
+    async def wait(self, request_id: int,
+                   timeout: float | None = None) -> dict:
         """Block until the request is terminal; returns its status row
-        (the harvest result included)."""
-        resp = await self._rpc(op="wait", request_id=request_id)
+        (the harvest result included). ``timeout`` seconds bounds the
+        block with a clean :class:`TimeoutError`."""
+        resp = await self._rpc(timeout=timeout, op="wait",
+                               request_id=request_id)
         return resp["status"]
 
-    async def cancel(self, request_id: int) -> bool:
-        resp = await self._rpc(op="cancel", request_id=request_id)
+    async def cancel(self, request_id: int,
+                     timeout: float | None = None) -> bool:
+        resp = await self._rpc(timeout=timeout, op="cancel",
+                               request_id=request_id)
         return resp["cancelled"]
 
-    async def restore(self, request_id: int) -> bool:
-        resp = await self._rpc(op="restore", request_id=request_id)
+    async def restore(self, request_id: int,
+                      timeout: float | None = None) -> bool:
+        resp = await self._rpc(timeout=timeout, op="restore",
+                               request_id=request_id)
         return resp["restored"]
 
     async def resume(self, request_id: int, mode: str = "ticks",
-                     ticks: int = 16) -> None:
-        await self._rpc(op="resume", request_id=request_id, mode=mode,
-                        ticks=ticks)
+                     ticks: int = 16, timeout: float | None = None) -> None:
+        await self._rpc(timeout=timeout, op="resume",
+                        request_id=request_id, mode=mode, ticks=ticks)
 
-    async def stats(self) -> dict:
-        resp = await self._rpc(op="stats")
+    async def stats(self, timeout: float | None = None) -> dict:
+        resp = await self._rpc(timeout=timeout, op="stats")
         return resp["stats"]
 
     async def shutdown(self) -> None:

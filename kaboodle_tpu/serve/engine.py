@@ -5,7 +5,10 @@ and advances them all with a continuous-batching round loop:
 
 1. **admit** — queued requests drop into free lanes of their class pool
    (one warmed reseed dispatch each; the retired occupant is overwritten
-   in place on device).
+   in place on device). With an :class:`~kaboodle_tpu.serve.admission.
+   AdmissionController` attached, admission runs in priority order and a
+   strictly lower-priority PARKED lane may be spill-evicted to make room
+   (running lanes are never preempted).
 2. **advance** — each pool with active lanes runs either ONE masked
    fleet-leap dispatch (per-member horizons: every horizon-mode lane leaps
    exactly its own ``k_m``, converge-mode and hot lanes freeze at
@@ -18,9 +21,26 @@ and advances them all with a continuous-batching round loop:
    fetch, emitted as ``serve_event`` records, then parked or released.
    Released lanes are immediately re-seedable: retire/re-seed never leaves
    the warmed program set.
-4. **spill** — parked lanes idle past ``spill_after`` rounds are gathered
-   (traced-lane fetch) and written through ``checkpoint.save``; a later
-   ``restore`` inserts them back into a free lane of the same class.
+4. **spill** — parked lanes idle past ``spill_after`` rounds dispatch a
+   traced-lane gather (fresh immutable device buffers) and hand it to the
+   background :class:`~kaboodle_tpu.serve.spill.SpillManager`, whose
+   writer thread blocks on the device->host transfer and the disk write;
+   the round loop NEVER blocks on either. Initiation is paced
+   (``spills_per_round``, default 1) so a burst of idle lanes costs one
+   gather dispatch per round, not a stall. The lane stays held (state
+   ``spilling``) until the write is durable, then frees; a failed write
+   degrades the lane back to parked with a loud ``spill_failed`` event.
+   ``sync_spill=True`` keeps the old blocking write — the chaos harness's
+   A/B baseline.
+
+Crash safety: with ``journal_dir`` set, every lifecycle transition is
+appended to a write-ahead :class:`~kaboodle_tpu.serve.journal.
+ServeJournal` before the engine acts on it, and a restarted engine's
+:meth:`ServeEngine.recover` folds the journal back into a live request
+table — completed requests keep their results (nothing replays twice),
+spilled requests re-attach to their durable files, and requests whose
+lane state died with the process re-queue from their seeds with
+cumulative tick budgets.
 
 Correctness rules the loop enforces:
 
@@ -44,6 +64,8 @@ from collections import OrderedDict
 import jax.numpy as jnp
 import numpy as np
 
+from kaboodle_tpu.errors import CheckpointError
+from kaboodle_tpu.serve.admission import AdmissionError
 from kaboodle_tpu.serve.pool import LanePool, lane_n_class
 from kaboodle_tpu.telemetry.manifest import run_record
 from kaboodle_tpu.warp.horizon import decode_signature
@@ -59,6 +81,7 @@ from kaboodle_tpu.warp.runner import (
 QUEUED = "queued"
 RUNNING = "running"
 PARKED = "parked"
+SPILLING = "spilling"  # lane held; background write not yet durable
 SPILLED = "spilled"
 DONE = "done"
 CANCELLED = "cancelled"
@@ -73,7 +96,9 @@ class ServeRequest:
     per the admission parity pin. ``mode="ticks"`` runs exactly ``ticks``
     ticks (horizon mode) — the lane the warp fast-forward applies to.
     ``keep=True`` parks the finished lane (spillable, resumable) instead
-    of releasing it."""
+    of releasing it. ``tenant`` names the quota bucket and ``priority``
+    the admission class (higher admits first; both inert without an
+    :class:`~kaboodle_tpu.serve.admission.AdmissionController`)."""
 
     n: int
     seed: int = 0
@@ -82,12 +107,16 @@ class ServeRequest:
     drop_rate: float = 0.0
     scenario: str = "boot"
     keep: bool = False
+    tenant: str = "default"
+    priority: int = 1
 
     def __post_init__(self):
         if self.mode not in ("converge", "ticks"):
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.ticks < 1:
             raise ValueError("need ticks >= 1")
+        if self.priority < 0:
+            raise ValueError("need priority >= 0")
 
     @property
     def n_class(self) -> int:
@@ -98,12 +127,29 @@ class ServeRequest:
         return self.mode == "converge"
 
 
+def _fresh_row(req: ServeRequest) -> dict:
+    return {
+        "req": req,
+        "state": QUEUED,
+        "lane": None,
+        "pool": req.n_class,
+        "generation": None,
+        "result": None,
+        "idle_rounds": 0,
+        "spill_path": None,
+        "saved_run": None,
+        "retry_spill": False,
+    }
+
+
 class ServeEngine:
     """Continuous-batching round loop over a dict of lane pools.
 
     ``pools`` maps pow2 N-class -> :class:`LanePool`; requests are routed
     by :func:`lane_n_class`. ``on_event`` (optional) is called with every
     emitted manifest record as it happens — the server's live stream tap.
+    ``admission`` (optional) gates submits; ``journal_dir`` (optional)
+    write-ahead-logs every transition for :meth:`recover`.
     """
 
     def __init__(
@@ -114,6 +160,11 @@ class ServeEngine:
         spill_after: int | None = None,
         spill_dir: str | None = None,
         on_event=None,
+        admission=None,
+        journal_dir: str | None = None,
+        sync_spill: bool = False,
+        spill_depth: int = 4,
+        spills_per_round: int = 1,
     ) -> None:
         self.pools: dict[int, LanePool] = {}
         for pool in pools:
@@ -129,19 +180,60 @@ class ServeEngine:
         self.spill_after = spill_after
         self.spill_dir = spill_dir
         self.on_event = on_event
+        self.admission = admission
+        self.sync_spill = bool(sync_spill)
+        self.spill_depth = int(spill_depth)
+        # Idle spills initiated per round: even the HOST side of a spill
+        # (the traced-lane gather + device->host copy) costs a round-loop
+        # slice, so initiation is paced — one copy per round keeps round
+        # latency within the no-spill envelope however many lanes idle out
+        # together. Evictions (preemption) bypass the pacing: admission
+        # needs the lane this round.
+        self.spills_per_round = int(spills_per_round)
+        self.journal = None
+        if journal_dir is not None:
+            from kaboodle_tpu.serve.journal import ServeJournal
+
+            self.journal = ServeJournal(journal_dir)
         self.round = 0
         self._next_rid = 0
+        self._events: list[dict] = []
+        self._spiller = None  # lazy: engines that never spill get no thread
         # rid -> bookkeeping row; insertion order is admission FIFO order.
         self._requests: OrderedDict[int, dict] = OrderedDict()
         # (n_class, lane) -> rid for lanes currently occupied by a request.
         self._lane_owner: dict[tuple[int, int], int] = {}
+
+    @property
+    def spiller(self):
+        if self._spiller is None:
+            from kaboodle_tpu.serve.spill import SpillManager
+
+            self._spiller = SpillManager(depth=self.spill_depth)
+        return self._spiller
+
+    def close(self) -> None:
+        """Join outstanding spill I/O and release the journal handle."""
+        if self._spiller is not None:
+            self._spiller.flush()
+            self._poll_spills()
+            self._spiller.close()
+        if self.journal is not None:
+            self.journal.close()
+
+    def _log(self, op: str, rid: int, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(op, rid, **fields)
 
     # -- request surface ---------------------------------------------------
 
     def submit(self, req: ServeRequest) -> int:
         """Queue a request; returns its request id. Raises on an unserved
         N-class or a faulty knob no pool can honor — rejection is loud,
-        not an event."""
+        not an event. With admission control attached, quota and
+        queue-capacity rejections raise structured
+        :class:`~kaboodle_tpu.serve.admission.AdmissionError` subclasses
+        carrying ``retry_after_s`` (emitted as ``rejected`` events)."""
         n_class = req.n_class
         pool = self.pools.get(n_class)
         if pool is None:
@@ -154,31 +246,68 @@ class ServeEngine:
             raise ValueError(
                 f"pool n={n_class} is fault-free; drop_rate must be 0"
             )
+        if self.admission is not None:
+            try:
+                self._admission_gate(req)
+            except AdmissionError as e:
+                self._emit_standalone(
+                    "serve_event", event="rejected", request_id=-1,
+                    pool_n=n_class, lane=-1, tenant=req.tenant,
+                    priority=req.priority, reason=e.kind,
+                    retry_after_s=e.retry_after_s,
+                )
+                raise
         rid = self._next_rid
         self._next_rid += 1
-        self._requests[rid] = {
-            "req": req,
-            "state": QUEUED,
-            "lane": None,
-            "pool": n_class,
-            "generation": None,
-            "result": None,
-            "idle_rounds": 0,
-            "spill_path": None,
-        }
+        self._requests[rid] = _fresh_row(req)
+        self._log("submitted", rid, req=dataclasses.asdict(req))
+        self._emit_standalone(
+            "serve_event", event="submitted", request_id=rid, pool_n=n_class,
+            lane=-1, tenant=req.tenant, priority=req.priority,
+        )
         return rid
+
+    def _admission_gate(self, req: ServeRequest) -> None:
+        """Quota first (one token per accepted OR shed-displacing submit),
+        then queue capacity — shedding the lowest-priority queued request
+        when the newcomer strictly outranks it, else raising queue_full."""
+        self.admission.check_quota(req.tenant)
+        queued = [
+            (rid, row)
+            for rid, row in self._requests.items()
+            if row["state"] == QUEUED
+        ]
+        if len(queued) < self.admission.max_queue:
+            return
+        victim = min(queued, key=lambda kv: (kv[1]["req"].priority, kv[0]))
+        if victim[1]["req"].priority < req.priority:
+            self._shed(victim[0], victim[1])
+            return
+        self.admission.check_queue(len(queued))
+
+    def _shed(self, rid: int, row: dict) -> None:
+        row["state"] = CANCELLED
+        self._log("shed", rid)
+        self._emit_standalone(
+            "serve_event", event="shed", request_id=rid, pool_n=row["pool"],
+            lane=-1, tenant=row["req"].tenant, priority=row["req"].priority,
+        )
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request in any non-terminal state; frees its lane."""
         row = self._requests.get(rid)
         if row is None or row["state"] in (DONE, CANCELLED):
             return False
-        if row["state"] in (RUNNING, PARKED):
+        if row["state"] in (RUNNING, PARKED, SPILLING):
             pool = self.pools[row["pool"]]
             pool.release(row["lane"])
             del self._lane_owner[(row["pool"], row["lane"])]
             row["lane"] = None
+        if self._spiller is not None:
+            self._spiller.drop_cache(rid)
         row["state"] = CANCELLED
+        row["retry_spill"] = False
+        self._log("cancelled", rid)
         self._emit("serve_event", event="cancelled", request_id=rid,
                    pool_n=row["pool"], lane=-1)
         return True
@@ -199,6 +328,8 @@ class ServeEngine:
             "n_class": row["pool"],
             "seed": req.seed,
             "mode": req.mode,
+            "tenant": req.tenant,
+            "priority": req.priority,
             "lane": row["lane"],
             "generation": row["generation"],
         }
@@ -210,24 +341,140 @@ class ServeEngine:
 
     # -- spill / restore ---------------------------------------------------
 
-    def _spill(self, rid: int, row: dict) -> None:
-        from kaboodle_tpu import checkpoint
-
-        pool = self.pools[row["pool"]]
-        lane = row["lane"]
-        path = os.path.join(
+    def _spill_path(self, rid: int, row: dict) -> str:
+        return os.path.join(
             self.spill_dir, f"lane-n{row['pool']}-req{rid}.npz"
         )
-        checkpoint.save(path, pool.member(lane))
-        pool.release(lane)
-        del self._lane_owner[(row["pool"], lane)]
-        row.update(state=SPILLED, lane=None, spill_path=path)
-        self._emit("serve_event", event="spilled", request_id=rid,
-                   pool_n=row["pool"], lane=lane, path=path)
+
+    def _begin_spill(self, rid: int, row: dict, evict: bool = False) -> bool:
+        """Start spilling a parked lane. Async path: host-copy now, hand
+        to the background writer, hold the lane (``spilling``) until the
+        write is durable — unless ``evict``, where the lane frees
+        immediately and the host copy is the request until durable.
+        Returns False (and emits ``spill_deferred``) when the bounded
+        write queue is full — the caller retries next round."""
+        pool = self.pools[row["pool"]]
+        lane = row["lane"]
+        path = self._spill_path(rid, row)
+        saved_run = pool.run_counters(lane)
+        if self.sync_spill:
+            from kaboodle_tpu import checkpoint
+
+            checkpoint.save(path, pool.member(lane), atomic=True)
+            pool.release(lane)
+            del self._lane_owner[(row["pool"], lane)]
+            row.update(state=SPILLED, lane=None, spill_path=path,
+                       saved_run=saved_run)
+            self._log("spilled", rid, path=path, saved_run=saved_run)
+            self._emit("serve_event",
+                       event="preempted" if evict else "spilled",
+                       request_id=rid, pool_n=row["pool"], lane=lane,
+                       path=path)
+            return True
+        # A thunk binding the warmed gather to the current (immutable)
+        # mesh snapshot: the writer thread executes the gather and the
+        # device->host transfer, so the round loop pays only a queue put.
+        member = pool.member_snapshot(lane)
+        if not self.spiller.submit_write(rid, path, member):
+            self.spiller.drop_cache(rid)
+            self._emit("serve_event", event="spill_deferred", request_id=rid,
+                       pool_n=row["pool"], lane=lane)
+            return False
+        self._log("spill_begin", rid, path=path)
+        row.update(spill_path=path, saved_run=saved_run)
+        if evict:
+            pool.release(lane)
+            del self._lane_owner[(row["pool"], lane)]
+            row.update(state=SPILLED, lane=None)
+            self._emit("serve_event", event="preempted", request_id=rid,
+                       pool_n=row["pool"], lane=lane, path=path)
+        else:
+            row["state"] = SPILLING
+        return True
+
+    def _poll_spills(self) -> None:
+        """Fold completed background I/O into request state (round start).
+
+        A durable write frees a ``spilling`` lane (or just journals an
+        evicted one); a failed write degrades a held lane back to parked
+        — loudly — or, for an evicted lane whose host copy is the only
+        remaining state, marks the write for retry."""
+        if self._spiller is None:
+            return
+        for res in self._spiller.poll():
+            row = self._requests.get(res.rid)
+            if row is None or res.op == "read":
+                continue  # prefetch results live in the cache
+            if res.ok:
+                if row["state"] == SPILLING:
+                    pool = self.pools[row["pool"]]
+                    lane = row["lane"]
+                    pool.release(lane)
+                    del self._lane_owner[(row["pool"], lane)]
+                    row.update(state=SPILLED, lane=None)
+                    self._log("spilled", res.rid, path=res.path,
+                              saved_run=row["saved_run"])
+                    self._emit("serve_event", event="spilled",
+                               request_id=res.rid, pool_n=row["pool"],
+                               lane=lane, path=res.path)
+                elif row["state"] == SPILLED:
+                    self._log("spilled", res.rid, path=res.path,
+                              saved_run=row["saved_run"])
+                    self._emit("serve_event", event="spilled",
+                               request_id=res.rid, pool_n=row["pool"],
+                               lane=-1, path=res.path)
+                # restored/cancelled while in flight: the file is a
+                # harmless stale snapshot; nothing to transition.
+            elif row["state"] == SPILLING:
+                self.spiller.drop_cache(res.rid)
+                row.update(state=PARKED, idle_rounds=0, spill_path=None,
+                           saved_run=None)
+                self._log("spill_failed", res.rid, path=res.path,
+                          error=res.error)
+                self._emit("serve_event", event="spill_failed",
+                           request_id=res.rid, pool_n=row["pool"],
+                           lane=row["lane"], error=res.error)
+            elif row["state"] == SPILLED:
+                row["retry_spill"] = True
+                self._log("spill_failed", res.rid, path=res.path,
+                          error=res.error)
+                self._emit("serve_event", event="spill_failed",
+                           request_id=res.rid, pool_n=row["pool"], lane=-1,
+                           error=res.error, retrying=True)
+
+    def _retry_spills(self) -> None:
+        for rid, row in self._requests.items():
+            if not row["retry_spill"]:
+                continue
+            member = self.spiller.cached(rid)
+            if member is None:  # a racing write actually landed
+                row["retry_spill"] = False
+                continue
+            if self.spiller.submit_write(rid, row["spill_path"], member):
+                row["retry_spill"] = False
+
+    def settle_spills(self, max_rounds: int = 100) -> None:
+        """Block until no spill write is in flight or pending retry (test
+        and shutdown helper — the round loop itself never blocks)."""
+        if self._spiller is None:
+            return
+        for _ in range(max_rounds):
+            self._spiller.flush()
+            self.step()
+            if not any(
+                row["state"] == SPILLING or row["retry_spill"]
+                for row in self._requests.values()
+            ):
+                return
+        raise RuntimeError("spill writes still failing after retries")
 
     def restore(self, rid: int) -> bool:
         """Bring a spilled request back into a free lane (parked). Returns
-        False when its class pool has no free lane right now."""
+        False when its class pool has no free lane right now. Prefers the
+        spill manager's host cache (an evicted lane whose write has not
+        landed yet restores from memory); a missing/corrupt spill file
+        raises :class:`CheckpointError` after a ``restore_failed`` event —
+        the request stays spilled, the engine keeps serving."""
         from kaboodle_tpu import checkpoint
 
         row = self._requests.get(rid)
@@ -237,10 +484,27 @@ class ServeEngine:
         lane = pool.free_lane()
         if lane is None:
             return False
-        member = checkpoint.load(row["spill_path"])
+        member = (
+            self._spiller.cached(rid) if self._spiller is not None else None
+        )
+        if member is None:
+            try:
+                member = checkpoint.load(row["spill_path"])
+            except CheckpointError as e:
+                self._emit_standalone(
+                    "serve_event", event="restore_failed", request_id=rid,
+                    pool_n=row["pool"], lane=-1, error=str(e),
+                )
+                raise
         row["generation"] = pool.insert(lane, member)
+        if row["saved_run"] is not None:
+            pool.set_run_counters(lane, row["saved_run"])
+        if self._spiller is not None:
+            self._spiller.drop_cache(rid)
+        row["retry_spill"] = False
         self._lane_owner[(row["pool"], lane)] = rid
         row.update(state=PARKED, lane=lane, idle_rounds=0)
+        self._log("restored", rid)
         self._emit("serve_event", event="restored", request_id=rid,
                    pool_n=row["pool"], lane=lane,
                    generation=row["generation"])
@@ -260,9 +524,81 @@ class ServeEngine:
         row["state"] = RUNNING
         row["idle_rounds"] = 0
         row["result"] = None  # the continuation's harvest replaces it
+        self._log("resumed", rid, mode=mode, ticks=int(ticks))
         self._emit("serve_event", event="resumed", request_id=rid,
                    pool_n=row["pool"], lane=row["lane"], mode=mode,
                    ticks=int(ticks))
+
+    # -- crash recovery ----------------------------------------------------
+
+    def recover(self) -> dict:
+        """Rebuild the request table from this engine's journal.
+
+        Call on a FRESH engine (warmed pools, empty table) constructed
+        with the crashed engine's ``journal_dir``. Folding rules: terminal
+        rows keep their results and are never re-run; rows whose last
+        durable state is a spill file re-attach as ``spilled`` (their
+        host run counters ride along, so a later restore+resume is a true
+        continuation); everything else — queued, running, parked-but-not-
+        spilled, mid-spill — lost its device state with the process and
+        re-queues from its seed with a cumulative tick budget (original
+        budget plus every journaled ticks-mode resume). Returns per-
+        disposition counts, emits ``recovered`` (+ per-row ``requeued``),
+        and compacts the journal."""
+        if self.journal is None:
+            raise ValueError("recover() needs an engine with journal_dir")
+        if self._requests:
+            raise ValueError("recover() needs an empty engine")
+        table, next_rid = self.journal.replay()
+        counts = {"done": 0, "spilled": 0, "requeued": 0, "cancelled": 0,
+                  "dropped": 0}
+        requeued: list[int] = []
+        for rid in sorted(table):
+            jrow = table[rid]
+            if jrow.get("req") is None:
+                counts["dropped"] += 1  # torn submit: client never acked
+                continue
+            req = ServeRequest(**jrow["req"])
+            if req.n_class not in self.pools:
+                counts["dropped"] += 1
+                continue
+            row = _fresh_row(req)
+            op = jrow.get("op")
+            spill_ok = bool(jrow.get("spill_path")) and os.path.exists(
+                jrow["spill_path"]
+            )
+            if op in ("cancelled", "shed"):
+                row["state"] = CANCELLED
+                counts["cancelled"] += 1
+            elif spill_ok and op in ("spilled", "restored"):
+                row.update(state=SPILLED, spill_path=jrow["spill_path"],
+                           saved_run=jrow.get("saved_run"),
+                           result=jrow.get("result"))
+                counts["spilled"] += 1
+            elif op == "harvested":
+                row.update(state=DONE, result=jrow.get("result"))
+                counts["done"] += 1
+            else:
+                extra = int(jrow.get("extra_ticks", 0))
+                if extra:
+                    req = dataclasses.replace(req, ticks=req.ticks + extra)
+                    row["req"] = req
+                counts["requeued"] += 1
+                requeued.append(rid)
+            self._requests[rid] = row
+        self._next_rid = max(self._next_rid, next_rid)
+        self.journal.compact(table, self._next_rid)
+        for rid in requeued:
+            self._log("requeued", rid)
+            self._emit_standalone(
+                "serve_event", event="requeued", request_id=rid,
+                pool_n=self._requests[rid]["pool"], lane=-1,
+            )
+        self._emit_standalone(
+            "serve_event", event="recovered", request_id=-1, lane=-1,
+            pool_n=min(self.pools), **counts,
+        )
+        return counts
 
     # -- the round loop ----------------------------------------------------
 
@@ -272,12 +608,24 @@ class ServeEngine:
             return True
         return any(pool.active.any() for pool in self.pools.values())
 
+    @property
+    def spilling(self) -> bool:
+        """Spill I/O in flight or pending retry (the server's idle loop
+        keeps stepping while this holds, so completions get folded)."""
+        return any(
+            row["state"] == SPILLING or row["retry_spill"]
+            for row in self._requests.values()
+        )
+
     def step(self) -> list[dict]:
-        """One engine round: admit, advance every pool, harvest, spill.
+        """One engine round: fold spill completions, admit, advance every
+        pool, harvest, spill. Never blocks on disk.
 
         Returns the manifest records emitted this round (also fanned out
         through ``on_event`` as they happen)."""
-        self._events: list[dict] = []
+        self._events = []
+        self._poll_spills()
+        self._retry_spills()
         self._admit_queued()
         for pool in self.pools.values():
             if not pool.active.any():
@@ -287,6 +635,9 @@ class ServeEngine:
             self._harvest(pool)
         self._spill_idle()
         self.round += 1
+        if self.journal is not None and self.journal.should_compact():
+            table, next_rid = self.journal.replay()
+            self.journal.compact(table, max(next_rid, self._next_rid))
         return self._events
 
     def drain(self, max_rounds: int = 10_000) -> list[dict]:
@@ -299,13 +650,22 @@ class ServeEngine:
         raise RuntimeError(f"engine still busy after {max_rounds} rounds")
 
     def _admit_queued(self) -> None:
-        for rid, row in self._requests.items():
-            if row["state"] != QUEUED:
-                continue
+        queued = [
+            (rid, row)
+            for rid, row in self._requests.items()
+            if row["state"] == QUEUED
+        ]
+        if self.admission is not None:
+            # Priority classes admit first; FIFO (rid order) within one.
+            queued.sort(key=lambda kv: (-kv[1]["req"].priority, kv[0]))
+        for rid, row in queued:
             pool = self.pools[row["pool"]]
             lane = pool.free_lane()
+            if lane is None and self.admission is not None:
+                if self._preempt_for(row):
+                    lane = pool.free_lane()
             if lane is None:
-                continue  # class full this round; stays queued (FIFO)
+                continue  # class full this round; stays queued
             req: ServeRequest = row["req"]
             row["generation"] = pool.admit(
                 lane, seed=req.seed, drop_rate=req.drop_rate,
@@ -314,10 +674,34 @@ class ServeEngine:
             )
             self._lane_owner[(row["pool"], lane)] = rid
             row.update(state=RUNNING, lane=lane)
+            self._log("admitted", rid, lane=lane,
+                      generation=row["generation"])
             self._emit("serve_event", event="admitted", request_id=rid,
                        pool_n=row["pool"], lane=lane,
                        generation=row["generation"], seed=req.seed,
                        mode=req.mode, scenario=req.scenario)
+
+    def _preempt_for(self, row: dict) -> bool:
+        """Spill-evict one strictly lower-priority PARKED lane of this
+        class (lowest priority, then oldest-parked) to admit ``row``.
+        Running lanes are never preempted. Needs a spill_dir."""
+        if self.spill_dir is None:
+            return False
+        pri = row["req"].priority
+        victims = [
+            (rid, r)
+            for rid, r in self._requests.items()
+            if r["state"] == PARKED and r["pool"] == row["pool"]
+            and r["req"].priority < pri
+        ]
+        if not victims:
+            return False
+        vrid, vrow = min(
+            victims,
+            key=lambda kv: (kv[1]["req"].priority, -kv[1]["idle_rounds"],
+                            kv[0]),
+        )
+        return self._begin_spill(vrid, vrow, evict=True)
 
     def _try_leap_round(self, pool: LanePool) -> bool:
         """One masked fleet-leap dispatch if any horizon lane can cover
@@ -393,6 +777,7 @@ class ServeEngine:
                 event = "converged"
             else:
                 event = "exhausted"  # converge run: budget up, no agreement
+            self._log("harvested", rid, event=event, result=result)
             self._emit(
                 "serve_event", event=event, request_id=rid, pool_n=pool.n,
                 lane=lane, generation=row["generation"], **result,
@@ -409,12 +794,15 @@ class ServeEngine:
     def _spill_idle(self) -> None:
         if self.spill_after is None or self.spill_dir is None:
             return
+        begun = 0
         for rid, row in self._requests.items():
             if row["state"] != PARKED:
                 continue
             row["idle_rounds"] += 1
-            if row["idle_rounds"] > self.spill_after:
-                self._spill(rid, row)
+            if (row["idle_rounds"] > self.spill_after
+                    and begun < self.spills_per_round):
+                # deferred (queue full) => retried next round
+                begun += int(self._begin_spill(rid, row))
 
     # -- warmup ------------------------------------------------------------
 
@@ -424,7 +812,8 @@ class ServeEngine:
         pools the warp applies to — the signature fetch and every leap
         bucket 8..max_leap at ``k_m = 0`` (the masked span program freezes
         everyone bit-exactly at zero). After this the round loop's
-        admit/leap/chunk/harvest/spill path compiles nothing."""
+        admit/leap/chunk/harvest/spill path compiles nothing — the async
+        spill's host copies are device fetches, not programs."""
         for pool in self.pools.values():
             pool.warmup()
             if not self.warp or pool.faulty or pool.telemetry:

@@ -1,0 +1,165 @@
+"""Write-ahead journal of request lifecycle transitions (crash recovery).
+
+A crashed PR 10 engine forgot every in-flight request: the host-side
+``_requests`` table was the only record. The journal makes the table
+reconstructible: every lifecycle transition is appended to
+``<dir>/wal.jsonl`` (one JSON object per line, flushed per append) BEFORE
+the engine acts on it, and a periodic compaction snapshots the folded
+table into ``<dir>/manifest.json`` (atomic fsync-then-rename) and
+truncates the WAL — replay cost stays O(live transitions), not O(service
+lifetime).
+
+Record shape: ``{"op": <transition>, "rid": <id>, ...fields}``. The ops
+the engine writes: ``submitted`` (full request fields), ``admitted``,
+``harvested`` (terminal event + result), ``resumed``,
+``spill_begin``, ``spilled`` (path + the host run counters frozen at
+spill time), ``spill_failed``, ``restored``, ``cancelled``, ``shed``,
+``requeued``.
+
+:meth:`ServeJournal.replay` folds manifest + WAL into a per-rid table of
+last-known states; ``ServeEngine.recover`` turns that into a live request
+table — terminal rows keep their results (nothing replays twice), spilled
+rows re-attach to their files, everything whose lane state died with the
+process re-queues from its seed. A half-written last WAL line (the crash
+landed mid-append) is ignored, not fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+JOURNAL_VERSION = 1
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ServeJournal:
+    """Append-ahead request-lifecycle log + compacted manifest snapshot.
+
+    ``fsync=True`` fsyncs every append (true write-ahead durability);
+    default flushes to the OS per append — a process crash loses nothing,
+    a power cut may lose the tail, which recovery treats as re-queueable.
+    """
+
+    def __init__(
+        self, journal_dir: str, fsync: bool = False, compact_every: int = 256
+    ) -> None:
+        self.dir = os.fspath(journal_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.wal_path = os.path.join(self.dir, "wal.jsonl")
+        self.manifest_path = os.path.join(self.dir, "manifest.json")
+        self.fsync = bool(fsync)
+        self.compact_every = int(compact_every)
+        self._appends_since_compact = 0
+        self._f = open(self.wal_path, "a")
+
+    # -- write side --------------------------------------------------------
+
+    def append(self, op: str, rid: int, **fields) -> None:
+        rec = {"op": op, "rid": int(rid)}
+        rec.update(fields)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self._appends_since_compact += 1
+
+    def should_compact(self) -> bool:
+        return self._appends_since_compact >= self.compact_every
+
+    def compact(self, table: dict[int, dict], next_rid: int) -> None:
+        """Snapshot the folded table to ``manifest.json`` and truncate the
+        WAL. The snapshot lands atomically BEFORE the WAL is cut, so a
+        crash between the two replays some transitions twice into the same
+        folded rows — idempotent by construction."""
+        _write_json_atomic(
+            self.manifest_path,
+            {
+                "version": JOURNAL_VERSION,
+                "next_rid": int(next_rid),
+                "requests": {str(rid): row for rid, row in table.items()},
+            },
+        )
+        self._f.close()
+        self._f = open(self.wal_path, "w")
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self._appends_since_compact = 0
+
+    def close(self) -> None:
+        self._f.close()
+
+    # -- read side ---------------------------------------------------------
+
+    def replay(self) -> tuple[dict[int, dict], int]:
+        """Fold manifest snapshot + WAL into ``(table, next_rid)``.
+
+        ``table`` maps rid -> a journal-row dict: ``{"op": <last
+        transition>, "req": {...}, "result": ..., "spill_path": ...,
+        "saved_run": ..., "extra_ticks": <sum of ticks-mode resume
+        budgets>}`` — everything recover needs, nothing engine-internal."""
+        table: dict[int, dict] = {}
+        next_rid = 0
+        if os.path.exists(self.manifest_path):
+            with open(self.manifest_path) as f:
+                snap = json.load(f)
+            if snap.get("version") != JOURNAL_VERSION:
+                raise ValueError(
+                    f"journal manifest version {snap.get('version')!r} != "
+                    f"{JOURNAL_VERSION}"
+                )
+            table = {int(rid): row for rid, row in snap["requests"].items()}
+            next_rid = int(snap["next_rid"])
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail write: the crash point
+                    self._fold(table, rec)
+                    next_rid = max(next_rid, int(rec["rid"]) + 1)
+        return table, next_rid
+
+    @staticmethod
+    def _fold(table: dict[int, dict], rec: dict) -> None:
+        rid = int(rec["rid"])
+        row = table.setdefault(
+            rid,
+            {
+                "op": None,
+                "req": None,
+                "result": None,
+                "spill_path": None,
+                "saved_run": None,
+                "extra_ticks": 0,
+            },
+        )
+        op = rec["op"]
+        row["op"] = op
+        if op == "submitted":
+            row["req"] = rec.get("req")
+        elif op == "harvested":
+            row["result"] = rec.get("result")
+            row["event"] = rec.get("event")
+        elif op == "resumed":
+            # Cumulative continuation budget: a re-queued request re-runs
+            # its whole trajectory, original budget plus every resume.
+            if rec.get("mode") == "ticks":
+                row["extra_ticks"] += int(rec.get("ticks", 0))
+        elif op == "spilled":
+            row["spill_path"] = rec.get("path")
+            row["saved_run"] = rec.get("saved_run")
+        elif op == "spill_failed":
+            pass  # lane still held (or cache retried); last op stands

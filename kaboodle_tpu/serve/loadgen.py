@@ -19,6 +19,15 @@ Every completion latency is submit-to-harvest. The steady phase runs under
 the KB405 compile counter and the banked report pins ``compiles_steady ==
 0`` — the zero-recompile-after-warmup acceptance gate, measured on the
 serving path itself.
+
+``--overload`` (BENCH_serve_overload.json) swaps the phases for an
+admission-control study: a closed-loop calibration measures capacity, then
+open-loop phases offer 2x / 5x / 10x that rate with mixed tenants and
+priorities against a bounded queue. Submits are pipelined raw (a rejection
+is a response, not an exception), so the offered schedule really is
+open-loop; the report banks goodput, shed rate and admitted-latency
+percentiles per phase — the overload curves — plus the same
+``compiles_steady == 0`` pin across every phase.
 """
 
 from __future__ import annotations
@@ -104,6 +113,143 @@ async def _open_loop(client_factory, n: int, requests: int, rate: float):
         await client.close()
     elapsed = time.perf_counter() - start
     return lat, elapsed
+
+
+async def _overload_phase(client_factory, port: int, n: int,
+                          rate: float, requests: int) -> dict:
+    """One open-loop overload phase: ``requests`` submits offered at
+    ``rate`` req/s on a raw pipelined connection (when the schedule is
+    behind, lines go out back to back with no response roundtrip — a
+    closed-loop client can never outrun the engine), mixed tenants and
+    priorities. Rejections arrive as structured error responses; every
+    admitted rid gets a waiter, and a shed admission counts against
+    goodput just like a rejection."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    submit_t: list[float] = []
+    lat: list[float] = []
+    waiters: list[asyncio.Task] = []
+    counts = {"completed": 0, "shed": 0, "rejected": 0}
+
+    async def complete(rid: int, t0: float) -> None:
+        c = await client_factory()
+        try:
+            row = await c.wait(rid)
+            if row["state"] == "done":
+                counts["completed"] += 1
+                lat.append(time.perf_counter() - t0)
+            else:
+                counts["shed"] += 1
+        finally:
+            await c.close()
+
+    async def read_responses() -> None:
+        for i in range(requests):
+            resp = json.loads(await reader.readline())
+            if resp.get("ok"):
+                # submit_t[i] exists: the server can only respond to a
+                # line written after its timestamp was appended.
+                waiters.append(asyncio.create_task(
+                    complete(resp["request_id"], submit_t[i])))
+            else:
+                counts["rejected"] += 1
+
+    async def offer() -> None:
+        start = time.perf_counter()
+        for i in range(requests):
+            delay = start + i / rate - time.perf_counter()
+            if delay > 0:
+                await writer.drain()
+                await asyncio.sleep(delay)
+            op = {"op": "submit", "n": n, "tenant": f"t{i % 3}",
+                  "priority": i % 3, **_mix_fields(i)}
+            submit_t.append(time.perf_counter())
+            writer.write(json.dumps(op).encode() + b"\n")
+        await writer.drain()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(offer(), read_responses())
+    await asyncio.gather(*waiters)
+    elapsed = time.perf_counter() - t0
+    writer.close()
+    admitted = requests - counts["rejected"]
+    return {
+        "offered_rps": round(rate, 2),
+        "requests": requests,
+        "admitted": admitted,
+        "rejected": counts["rejected"],
+        "shed": counts["shed"],
+        "completed": counts["completed"],
+        "goodput_rps": round(counts["completed"] / elapsed, 2),
+        "shed_rate": round(
+            (counts["rejected"] + counts["shed"]) / requests, 3),
+        "elapsed_s": round(elapsed, 3),
+        "latency": _latency_stats(lat) if lat else None,
+    }
+
+
+async def _run_overload(args) -> dict:
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.admission import AdmissionController
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.engine import ServeEngine
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.serve.server import ServeServer
+
+    assert_counter_live()
+    pool = LanePool(args.n, args.lanes, chunk=args.chunk)
+    admission = AdmissionController(max_queue=args.max_queue)
+    engine = ServeEngine([pool], warp=not args.no_warp,
+                         max_leap=args.max_leap, admission=admission)
+    server = ServeServer(engine, port=0)
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    await server.start()
+
+    async def client_factory():
+        return await ServeClient.connect(port=server.port)
+
+    warm_client = await client_factory()
+    for i in range(2 * args.lanes):
+        rid = await warm_client.submit(args.n, **_mix_fields(i))
+        await warm_client.wait(rid)
+    await warm_client.close()
+
+    with compile_counter() as box:
+        cal_lat, cal_s = await _closed_loop(
+            client_factory, args.n, args.requests, args.concurrency
+        )
+        capacity_rps = len(cal_lat) / cal_s
+        phases = {}
+        for mult in (2, 5, 10):
+            phases[f"{mult}x"] = await _overload_phase(
+                client_factory, server.port, args.n,
+                rate=capacity_rps * mult, requests=args.requests,
+            )
+    compiles = box.count
+
+    probe = await client_factory()
+    stats = await probe.stats()
+    await probe.shutdown()
+    await server.close()
+
+    return {
+        "bench": "serve-overload",
+        "n": args.n,
+        "lanes": args.lanes,
+        "chunk": args.chunk,
+        "warp": not args.no_warp,
+        "max_queue": args.max_queue,
+        "warmup_s": round(warmup_s, 3),
+        "compiles_steady": compiles,
+        "capacity_rps": round(capacity_rps, 2),
+        "calibration_latency": _latency_stats(cal_lat),
+        "phases": phases,
+        "engine_rounds": stats["round"],
+    }
 
 
 async def _run(args) -> dict:
@@ -195,10 +341,21 @@ def main(argv=None) -> int:
                         help="open-loop offered req/s")
     parser.add_argument("--max-leap", type=int, default=64)
     parser.add_argument("--no-warp", action="store_true")
-    parser.add_argument("--out", default="BENCH_serve.json")
+    parser.add_argument("--overload", action="store_true",
+                        help="admission-control study: calibrate capacity, "
+                             "then offer 2x/5x/10x against a bounded queue")
+    parser.add_argument("--max-queue", type=int, default=None,
+                        help="admission queue bound for --overload "
+                             "(default 2*lanes)")
+    parser.add_argument("--out", default=None)
     args = parser.parse_args(argv)
+    if args.max_queue is None:
+        args.max_queue = 2 * args.lanes
+    if args.out is None:
+        args.out = ("BENCH_serve_overload.json" if args.overload
+                    else "BENCH_serve.json")
 
-    report = asyncio.run(_run(args))
+    report = asyncio.run(_run_overload(args) if args.overload else _run(args))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")

@@ -295,6 +295,28 @@ class LanePool:
     def park(self, lane: int) -> None:
         self.active[lane] = False
 
+    def run_counters(self, lane: int) -> dict:
+        """Lane ``lane``'s host run counters, frozen for a spill. Restoring
+        them via :meth:`set_run_counters` is what makes a restore into a
+        DIFFERENT lane a true continuation — the husk vectors of the new
+        lane belong to whoever ran there last, not to this request."""
+        return {
+            "ticks_run": int(self.ticks_run[lane]),
+            "conv_tick": int(self.conv_tick[lane]),
+            "messages": int(self.messages[lane]),
+            "until_conv": bool(self.until_conv[lane]),
+            "remaining": int(self.remaining[lane]),
+        }
+
+    def set_run_counters(self, lane: int, counters: dict) -> None:
+        """Write spilled run counters back into lane ``lane`` (the restore
+        half of :meth:`run_counters`)."""
+        self.ticks_run[lane] = int(counters["ticks_run"])
+        self.conv_tick[lane] = int(counters["conv_tick"])
+        self.messages[lane] = int(counters["messages"])
+        self.until_conv[lane] = bool(counters["until_conv"])
+        self.remaining[lane] = int(counters["remaining"])
+
     def release(self, lane: int) -> None:
         """Retire a lane: mark it free. The husk state stays resident (and
         frozen — inactive lanes never advance) until the next re-seed
@@ -306,6 +328,19 @@ class LanePool:
         """Lane ``lane``'s mesh as a standalone ``MeshState`` (device) via
         the traced-lane gather — safe inside the zero-recompile phase."""
         return _member_fetch()(self.mesh, jnp.int32(lane))
+
+    def member_snapshot(self, lane: int):
+        """A zero-arg thunk for :meth:`member` bound to the CURRENT mesh.
+
+        The mesh pytree reference is captured now (its buffers are
+        immutable — later rounds rebind ``self.mesh`` to fresh outputs,
+        they never mutate these), and the warmed gather program is looked
+        up now, so the thunk can execute on a background thread without
+        touching the pool or the program cache. This is how spills get the
+        gather itself off the round loop, not just the disk write."""
+        mesh = self.mesh
+        fetch = _member_fetch()
+        return lambda: fetch(mesh, jnp.int32(lane))
 
     # -- stepping ----------------------------------------------------------
 

@@ -29,14 +29,17 @@ import asyncio
 import dataclasses
 import json
 
+from kaboodle_tpu.errors import CheckpointError
+from kaboodle_tpu.serve.admission import AdmissionError
 from kaboodle_tpu.serve.engine import (
     CANCELLED,
     DONE,
     PARKED,
+    SPILLING,
     ServeEngine,
     ServeRequest,
 )
-from kaboodle_tpu.telemetry.manifest import ManifestWriter
+from kaboodle_tpu.telemetry.manifest import ManifestWriter, run_record
 
 
 def _wait_done(row: dict) -> bool:
@@ -56,6 +59,52 @@ _SUBMIT_FIELDS = frozenset(
 _IDLE_POLL_S = 0.02
 
 
+class _Subscriber:
+    """One stream connection's bounded event queue.
+
+    A consumer that stops reading cannot wedge the server: past
+    ``maxsize`` buffered records, new events are counted instead of
+    queued, and the count surfaces as one ``stream_gap`` record the
+    moment the queue has room again — the subscriber KNOWS it lost
+    records, and every other connection is unaffected."""
+
+    def __init__(self, maxsize: int) -> None:
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self.dropped = 0
+
+    def push(self, rec) -> None:
+        if self.q.full():
+            self.dropped += 1
+            return
+        if self.dropped:
+            self.q.put_nowait(run_record("stream_gap", dropped=self.dropped))
+            self.dropped = 0
+            if self.q.full():
+                self.dropped = 1  # `rec` itself no longer fits
+                return
+        self.q.put_nowait(rec)
+
+    def push_sentinel(self) -> None:
+        if self.q.full():  # make room: the close sentinel must land
+            self.q.get_nowait()
+        self.q.put_nowait(None)
+
+
+def _error_response(e: Exception) -> dict:
+    """Map an op failure to a structured error a client can act on."""
+    resp = {"ok": False, "error": str(e) or type(e).__name__}
+    if isinstance(e, AdmissionError):
+        resp["kind"] = e.kind  # queue_full | quota
+        resp["retry_after_s"] = e.retry_after_s
+    elif isinstance(e, CheckpointError):
+        resp["kind"] = "checkpoint"
+    elif isinstance(e, (ValueError, KeyError, TypeError)):
+        resp["kind"] = "bad_request"  # includes malformed JSON lines
+    else:
+        resp["kind"] = "internal"
+    return resp
+
+
 class ServeServer:
     """One engine + one TCP listener + the live event fan-out."""
 
@@ -65,15 +114,17 @@ class ServeServer:
         host: str = "127.0.0.1",
         port: int = 0,
         manifest_path: str | None = None,
+        stream_queue: int = 256,
     ) -> None:
         self.engine = engine
         self.host = host
         self.port = port
+        self.stream_queue = int(stream_queue)
         self.manifest = (
             ManifestWriter(manifest_path, stream=True) if manifest_path else None
         )
         engine.on_event = self._on_event
-        self._subscribers: set[asyncio.Queue] = set()
+        self._subscribers: set[_Subscriber] = set()
         self._waiters: dict[int, list[asyncio.Future]] = {}
         self._wake = asyncio.Event()
         self._closed = asyncio.Event()
@@ -85,8 +136,8 @@ class ServeServer:
     def _on_event(self, rec: dict) -> None:
         if self.manifest is not None:
             self.manifest.write_record(rec)
-        for q in self._subscribers:
-            q.put_nowait(rec)
+        for sub in self._subscribers:
+            sub.push(rec)
 
     def _resolve_waiters(self) -> None:
         for rid in list(self._waiters):
@@ -116,13 +167,14 @@ class ServeServer:
             await self._server.wait_closed()
         if self._loop_task is not None:
             await self._loop_task
-        for q in self._subscribers:
-            q.put_nowait(None)
+        for sub in self._subscribers:
+            sub.push_sentinel()
         for futs in self._waiters.values():
             for fut in futs:
                 if not fut.done():
                     fut.cancel()
         self._waiters.clear()
+        self.engine.close()  # join spill I/O, release the journal handle
         if self.manifest is not None:
             self.manifest.close()
 
@@ -141,11 +193,14 @@ class ServeServer:
                 await asyncio.wait_for(self._wake.wait(), _IDLE_POLL_S)
             except asyncio.TimeoutError:
                 pass
-            if (
-                not self.engine.busy
-                and self.engine.spill_after is not None
-                and any(
-                    row["state"] == PARKED for row in self.engine.status()
+            if not self.engine.busy and (
+                self.engine.spilling  # fold in-flight write completions
+                or (
+                    self.engine.spill_after is not None
+                    and any(
+                        row["state"] in (PARKED, SPILLING)
+                        for row in self.engine.status()
+                    )
                 )
             ):
                 self.engine.step()
@@ -161,9 +216,11 @@ class ServeServer:
                     break
                 try:
                     op = json.loads(line)
+                    if not isinstance(op, dict):
+                        raise ValueError(f"op must be an object, got {op!r}")
                     resp = await self._dispatch(op, writer)
                 except Exception as e:  # op errors are responses, not crashes
-                    resp = {"ok": False, "error": str(e)}
+                    resp = _error_response(e)
                 if resp is None:  # stream mode took the connection over
                     return
                 writer.write(json.dumps(resp).encode() + b"\n")
@@ -186,7 +243,8 @@ class ServeServer:
             rid = int(op["request_id"])
             row = self.engine.status(rid)
             if row is None:
-                return {"ok": False, "error": f"unknown request {rid}"}
+                return {"ok": False, "error": f"unknown request {rid}",
+                        "kind": "bad_request"}
             if not _wait_done(row):
                 fut = asyncio.get_running_loop().create_future()
                 self._waiters.setdefault(rid, []).append(fut)
@@ -215,11 +273,12 @@ class ServeServer:
             self._closed.set()
             self._wake.set()
             return None
-        return {"ok": False, "error": f"unknown op {name!r}"}
+        return {"ok": False, "error": f"unknown op {name!r}",
+                "kind": "bad_request"}
 
     async def _stream(self, writer) -> None:
-        q: asyncio.Queue = asyncio.Queue()
-        self._subscribers.add(q)
+        sub = _Subscriber(self.stream_queue)
+        self._subscribers.add(sub)
         # Ack so the subscriber KNOWS it is attached before it triggers the
         # events it wants to see (no submit-vs-subscribe race).
         writer.write(
@@ -228,7 +287,7 @@ class ServeServer:
         await writer.drain()
         try:
             while True:
-                rec = await q.get()
+                rec = await sub.q.get()
                 if rec is None:  # server close sentinel
                     break
                 writer.write(json.dumps(rec).encode() + b"\n")
@@ -236,7 +295,7 @@ class ServeServer:
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
-            self._subscribers.discard(q)
+            self._subscribers.discard(sub)
 
 
 def main(argv=None) -> int:
@@ -266,14 +325,32 @@ def main(argv=None) -> int:
     parser.add_argument("--spill-after", type=int, default=None,
                         help="spill parked lanes idle this many rounds")
     parser.add_argument("--spill-dir", default=None)
+    parser.add_argument("--sync-spill", action="store_true",
+                        help="blocking spill writes on the round loop "
+                             "(the pre-hardening baseline; for A/B only)")
+    parser.add_argument("--journal-dir", default=None,
+                        help="write-ahead journal directory (crash recovery)")
+    parser.add_argument("--recover", action="store_true",
+                        help="replay --journal-dir before serving: re-queue "
+                             "lost requests, re-attach spilled ones")
+    parser.add_argument("--max-queue", type=int, default=None,
+                        help="bound the submit queue (enables admission "
+                             "control: priorities, shedding, retry-after)")
     parser.add_argument("--dryrun", action="store_true",
                         help="run the in-process CI exercise and exit")
+    parser.add_argument("--chaos-dryrun", action="store_true",
+                        help="run the seeded fault-injection scenarios and "
+                             "exit")
     args = parser.parse_args(argv)
 
     if args.dryrun:
         from kaboodle_tpu.serve.dryrun import run_dryrun
 
         return run_dryrun()
+    if args.chaos_dryrun:
+        from kaboodle_tpu.serve.chaos import run_chaos_dryrun
+
+        return run_chaos_dryrun()
 
     from kaboodle_tpu.serve.pool import LanePool, lane_n_class
 
@@ -286,10 +363,22 @@ def main(argv=None) -> int:
             LanePool(n, args.lanes, chunk=args.chunk,
                      telemetry=args.telemetry)
         )
+    admission = None
+    if args.max_queue is not None:
+        from kaboodle_tpu.serve.admission import AdmissionController
+
+        admission = AdmissionController(max_queue=args.max_queue)
     engine = ServeEngine(
         pools, warp=not args.no_warp, max_leap=args.max_leap,
         spill_after=args.spill_after, spill_dir=args.spill_dir,
+        sync_spill=args.sync_spill, journal_dir=args.journal_dir,
+        admission=admission,
     )
+    if args.recover:
+        if args.journal_dir is None:
+            parser.error("--recover needs --journal-dir")
+        counts = engine.recover()
+        print(f"recovered: {counts}", flush=True)
 
     async def run() -> None:
         server = ServeServer(

@@ -1,0 +1,184 @@
+"""Async spill/restore: checkpoint I/O off the engine round loop.
+
+PR 10's spill path was synchronous ``checkpoint.save`` inside the round
+loop — every idle-lane spill stalled EVERY active lane for one full npz
+write (milliseconds of fsync against a round budget of microseconds). The
+:class:`SpillManager` moves the disk work onto a background writer thread:
+
+- **double-buffered device→host copies**: the engine hands the manager a
+  zero-arg thunk (:meth:`LanePool.member_snapshot`) binding the warmed
+  traced-lane gather to the current mesh snapshot — the mesh buffers are
+  immutable, so the worker thread can execute the gather and the
+  device→host transfer itself (a device fetch, never a fresh compile)
+  while the round loop moves straight on. The bounded submit queue
+  (default depth 4) is the double buffer: at most ``depth`` spills are in
+  flight before ``submit_write`` reports backpressure and the engine
+  retries next round (``spill_deferred``).
+- **durability**: writes go through ``checkpoint.save(..., atomic=True)``
+  — same-directory temp file, fsync, rename — so a crash mid-spill leaves
+  either the previous complete file or the new complete file, never a
+  truncated archive for recovery to trip over.
+- **the host tree IS the request until the write is durable**: the cache
+  entry is dropped only when the writer reports success. A failed write
+  (disk full, injected chaos fault) leaves the cache intact, so an evicted
+  lane's state is never lost — the engine retries or degrades, loudly.
+- **restore prefetch**: ``prefetch`` reads a spill file back on the same
+  worker thread into the cache, so a planned restore's ``checkpoint.load``
+  cost is off the round loop too.
+
+Everything here is host-side stdlib threading; no traced code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+
+
+@dataclasses.dataclass(frozen=True)
+class SpillResult:
+    """One finished background I/O: ``op`` is ``"write"`` or ``"read"``."""
+
+    rid: int
+    path: str
+    op: str
+    ok: bool
+    error: str | None = None
+
+
+class SpillManager:
+    """Bounded-queue background writer/reader for lane spills.
+
+    ``depth`` bounds the number of in-flight host trees (the double
+    buffer); completions are polled by the engine at round start — the
+    worker thread never touches engine state directly, so the round loop
+    stays single-threaded from the device's point of view.
+    """
+
+    def __init__(self, depth: int = 4) -> None:
+        self._work: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._done: queue.Queue = queue.Queue()
+        self._cache: dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._fail_next = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="kaboodle-spill-writer", daemon=True
+        )
+        self._thread.start()
+
+    # -- engine-facing API (round-loop thread) -----------------------------
+
+    def submit_write(self, rid: int, path: str, member) -> bool:
+        """Queue a durable write of ``member`` to ``path``. ``member`` is
+        a state tree OR a zero-arg thunk producing one (the worker
+        materializes it off the round loop). Returns False — try again
+        next round — when the bounded queue is full. The tree (or thunk)
+        is cached until the write succeeds."""
+        with self._lock:
+            self._cache[rid] = member
+        try:
+            self._work.put_nowait(("write", rid, path, member))
+        except queue.Full:
+            return False
+        return True
+
+    def prefetch(self, rid: int, path: str) -> bool:
+        """Queue a background read of ``path`` into the cache (restore
+        warm-up). Returns False when the queue is full."""
+        try:
+            self._work.put_nowait(("read", rid, path, None))
+        except queue.Full:
+            return False
+        return True
+
+    def poll(self) -> list[SpillResult]:
+        """Drain completed background I/Os (non-blocking)."""
+        out: list[SpillResult] = []
+        while True:
+            try:
+                out.append(self._done.get_nowait())
+            except queue.Empty:
+                return out
+
+    def cached(self, rid: int):
+        """The state tree for ``rid`` if still resident (write not yet
+        durable, or a completed prefetch), else None. A still-deferred
+        thunk is materialized here (both threads may race to do so; the
+        results are identical by construction)."""
+        with self._lock:
+            member = self._cache.get(rid)
+        if callable(member):
+            member = member()
+            with self._lock:
+                if rid in self._cache:
+                    self._cache[rid] = member
+        return member
+
+    def drop_cache(self, rid: int) -> None:
+        with self._lock:
+            self._cache.pop(rid, None)
+
+    def pending(self) -> int:
+        """Writes/reads still queued or in flight (approximate)."""
+        return self._work.qsize()
+
+    def fail_next(self, k: int = 1) -> None:
+        """Chaos hook: the next ``k`` writes fail deterministically
+        (before touching disk), as if the target volume were full."""
+        with self._lock:
+            self._fail_next += int(k)
+
+    def flush(self) -> None:
+        """Block until every queued I/O has completed. Completions stay in
+        the done queue — the engine's ``_poll_spills`` must still fold
+        them (draining here would swallow the lane-state transitions)."""
+        self._work.join()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._work.put(None)
+        self._thread.join(timeout=10.0)
+
+    # -- worker thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        from kaboodle_tpu import checkpoint
+
+        while True:
+            item = self._work.get()
+            if item is None:
+                self._work.task_done()
+                return
+            op, rid, path, member = item
+            try:
+                if op == "write":
+                    with self._lock:
+                        inject = self._fail_next > 0
+                        if inject:
+                            self._fail_next -= 1
+                    if inject:
+                        raise OSError("injected spill-write failure")
+                    if callable(member):
+                        member = member()
+                        with self._lock:
+                            if rid in self._cache:
+                                self._cache[rid] = member
+                    checkpoint.save(path, member, atomic=True)
+                    # Durable: the file supersedes the host copy.
+                    with self._lock:
+                        self._cache.pop(rid, None)
+                else:
+                    loaded = checkpoint.load(path)
+                    with self._lock:
+                        self._cache[rid] = loaded
+                self._done.put(SpillResult(rid, path, op, ok=True))
+            except Exception as e:  # surfaces as a poll()ed failure record
+                self._done.put(
+                    SpillResult(rid, path, op, ok=False, error=str(e))
+                )
+            finally:
+                self._work.task_done()

@@ -201,3 +201,43 @@ def test_fleet_checkpoint_guards(tmp_path):
     checkpoint.save_fleet(fleet_path, init_fleet(8, 2))
     with pytest.raises(KaboodleError, match="missing fields"):
         checkpoint.load(fleet_path)
+
+
+def test_corrupt_file_guards(tmp_path):
+    """ISSUE 12 satellite: torn or alien files surface as CheckpointError
+    (never a raw BadZipFile/EOFError leaking out of numpy) from load and
+    load_fleet alike — the serve restore path relies on this to turn a
+    corrupt spill file into a structured error with the service intact."""
+    from kaboodle_tpu.errors import CheckpointError
+
+    st = init_state(8, seed=1)
+    good = tmp_path / "good.npz"
+    checkpoint.save(good, st)
+
+    data = good.read_bytes()
+    torn = tmp_path / "torn.npz"  # the zip central directory is gone
+    torn.write_bytes(data[: len(data) // 3])
+    with pytest.raises(CheckpointError):
+        checkpoint.load(torn)
+    with pytest.raises(CheckpointError):
+        checkpoint.load_fleet(torn)
+
+    alien = tmp_path / "alien.npz"  # wrong magic: not an archive at all
+    alien.write_bytes(b"definitely not a zip archive\n" * 4)
+    with pytest.raises(CheckpointError):
+        checkpoint.load(alien)
+
+    with pytest.raises(CheckpointError):
+        checkpoint.load(tmp_path / "missing.npz")
+    # CheckpointError IS a KaboodleError: existing handlers keep working.
+    assert issubclass(CheckpointError, KaboodleError)
+
+
+def test_atomic_save_is_complete_or_absent(tmp_path):
+    """atomic=True goes through fsync-then-rename: the final path holds a
+    complete archive and no temp file survives."""
+    st = init_state(8, seed=3)
+    path = tmp_path / "atomic.npz"
+    checkpoint.save(path, st, atomic=True)
+    _states_equal(st, checkpoint.load(path))
+    assert list(tmp_path.iterdir()) == [path]

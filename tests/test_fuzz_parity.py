@@ -10,6 +10,8 @@ Seeds are fixed, so failures reproduce.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pytest
 
@@ -625,3 +627,130 @@ def test_random_admission_schedule_serve_arm(seed):
         assert res["fp_min"] == int(fp_min) and res["fp_max"] == int(fp_max)
         assert res["n_alive"] == int(n_alive)
     assert finished > 0  # the schedule actually served something
+
+@pytest.mark.parametrize("seed", range(2))
+def test_random_spill_kill_recover_arm(seed, tmp_path):
+    """ISSUE 12 fuzz arm: a RANDOM park/spill schedule is crashed at a
+    random point past its spill horizon (engine abandoned mid-service, no
+    close) and recovered from the journal into a fresh engine — and every
+    request must land exactly where an uninterrupted twin lands: the
+    pre-crash completion keeps its result (replayed never), spilled
+    continuations restore+resume to bit-identical member states, the
+    in-flight request re-runs to the bit-identical final result, and the
+    whole kill/recover boundary compiles NOTHING."""
+    import jax
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+    from kaboodle_tpu.serve.pool import LanePool
+
+    assert_counter_live()
+    rng = np.random.default_rng(8000 + seed)
+    n = 16
+    cfg = SwimConfig(deterministic=True)
+    spill_after = int(rng.integers(0, 2))
+
+    def build(tag: str, spill_after_v):
+        # sync_spill: writes land before step() returns, so the kill point
+        # is a deterministic function of the drawn schedule.
+        os.makedirs(tmp_path / f"{tag}_spill", exist_ok=True)
+        return ServeEngine(
+            [LanePool(n, 2, cfg=cfg, chunk=8)], warp=False,
+            sync_spill=True, spill_after=spill_after_v,
+            spill_dir=str(tmp_path / f"{tag}_spill"),
+            journal_dir=str(tmp_path / f"{tag}_journal"),
+        )
+
+    n_kept = int(rng.integers(1, 3))
+    reqs = [
+        ServeRequest(n=n, seed=int(rng.integers(0, 50)), mode="ticks",
+                     ticks=8 * int(rng.integers(1, 4)), scenario="steady",
+                     keep=True)
+        for _ in range(n_kept)
+    ]
+    reqs.append(ServeRequest(n=n, seed=int(rng.integers(0, 50)),
+                             mode="converge", ticks=40,
+                             scenario="steady" if rng.integers(2) else "boot"))
+    # The crash victim: a horizon too long to finish before the kill.
+    reqs.append(ServeRequest(n=n, seed=int(rng.integers(0, 50)),
+                             mode="ticks", ticks=800, scenario="steady"))
+    resume_ticks = 8 * int(rng.integers(1, 4))
+    extra_steps = int(rng.integers(0, 4))
+
+    def drive_to_kill_point(eng, kept, conv):
+        for _ in range(600):
+            eng.step()
+            if (eng.status(conv)["state"] == "done" and all(
+                    eng.status(r)["state"] == "spilled" for r in kept)):
+                break
+        else:
+            raise AssertionError(f"seed {seed}: kill point never reached")
+        for _ in range(extra_steps):
+            eng.step()
+
+    def leaves(member):
+        return [np.asarray(x) for x in jax.tree.leaves(member)]
+
+    twin = build("twin", spill_after)
+    twin.warmup()
+    with compile_counter() as box:
+        # --- the uninterrupted twin ------------------------------------
+        t_rids = [twin.submit(r) for r in reqs]
+        t_kept, t_conv, t_long = t_rids[:n_kept], t_rids[-2], t_rids[-1]
+        drive_to_kill_point(twin, t_kept, t_conv)
+        twin.drain()
+        twin.spill_after = None  # continuations park again and hold lanes
+        for rid in t_kept:
+            assert twin.restore(rid)
+            twin.resume(rid, mode="ticks", ticks=resume_ticks)
+        twin.drain()
+        want_members = {
+            rid: leaves(twin.pools[n].member(twin.status(rid)["lane"]))
+            for rid in t_kept
+        }
+        want_conv = twin.status(t_conv)["result"]
+        want_long = twin.status(t_long)["result"]
+
+        # --- the victim: same schedule, crashed past the kill point ----
+        victim = build("victim", spill_after)
+        victim.warmup()
+        v_rids = [victim.submit(r) for r in reqs]
+        assert v_rids == t_rids  # same submission order, same rids
+        drive_to_kill_point(victim, v_rids[:n_kept], t_conv)
+        pre_kill_conv = victim.status(t_conv)["result"]
+        del victim  # the crash: no close, no flush, no compaction
+
+        # --- recovery into a fresh engine, same process ----------------
+        rec = build("victim", None)
+        rec.warmup()
+        counts = rec.recover()
+        assert counts == {"done": 1, "spilled": n_kept, "requeued": 1,
+                          "cancelled": 0, "dropped": 0}, counts
+        assert rec.status(t_conv)["result"] == pre_kill_conv == want_conv
+        # Drain the re-queued request BEFORE re-occupying lanes with the
+        # restored continuations (with n_kept == lanes they'd starve it).
+        rec.drain()
+        # The re-queued in-flight request re-ran its FULL horizon.
+        assert rec.status(t_long)["result"] == want_long
+        for rid in v_rids[:n_kept]:
+            assert rec.restore(rid)
+            rec.resume(rid, mode="ticks", ticks=resume_ticks)
+        rec.drain()
+        for rid in v_rids[:n_kept]:
+            got = leaves(rec.pools[n].member(rec.status(rid)["lane"]))
+            want = want_members[rid]
+            assert len(got) == len(want)
+            for x, y in zip(got, want):
+                eq = np.issubdtype(x.dtype, np.floating)
+                assert np.array_equal(x, y, equal_nan=eq), (
+                    f"seed {seed}: recovered continuation {rid} diverged"
+                )
+        rec.close()
+        twin.close()
+    assert box.count == 0, (
+        f"seed {seed}: {box.count} fresh compilations across the "
+        f"kill/recover boundary"
+    )

@@ -1,0 +1,404 @@
+"""Servefort (ISSUE 12): crash-safe, overload-safe serving — unit lanes.
+
+The deep end-to-end invariants (kill-mid-round bit-exactness, overload
+SLOs, spill round-latency A/B) live in the chaos harness
+(``make serve-chaos-dryrun``, kaboodle_tpu/serve/chaos.py); this file
+pins the pieces in isolation: journal fold/compaction, admission
+policy, the spill manager's failure/retry contract, engine recovery,
+and the server's structured-error + client timeout/retry surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+from kaboodle_tpu.serve.pool import LanePool
+
+CFG = SwimConfig(deterministic=True)
+N = 16
+
+
+def _pool(lanes: int = 2, **kw) -> LanePool:
+    return LanePool(N, lanes, cfg=CFG, chunk=8, **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        eq = np.issubdtype(x.dtype, np.floating)
+        if not np.array_equal(x, y, equal_nan=eq):
+            return False
+    return True
+
+
+# -- journal ----------------------------------------------------------------
+
+
+def test_journal_replay_folds_lifecycle(tmp_path):
+    from kaboodle_tpu.serve.journal import ServeJournal
+
+    j = ServeJournal(str(tmp_path / "j"))
+    j.append("submitted", 0, req={"n": 16, "seed": 7, "ticks": 24})
+    j.append("admitted", 0)
+    j.append("harvested", 0, event="completed", result={"ticks_run": 24})
+    j.append("submitted", 1, req={"n": 16, "seed": 8, "ticks": 8})
+    j.append("resumed", 1, mode="ticks", ticks=16)
+    j.append("resumed", 1, mode="ticks", ticks=8)
+    j.append("spilled", 1, path="/spill/1.npz", saved_run={"ticks_run": 32})
+    j.append("submitted", 2, req={"n": 16, "seed": 9})
+    j.append("cancelled", 2)
+    j.close()
+
+    table, next_rid = ServeJournal(str(tmp_path / "j")).replay()
+    assert next_rid == 3
+    assert table[0]["op"] == "harvested"
+    assert table[0]["result"] == {"ticks_run": 24}
+    assert table[1]["op"] == "spilled"
+    assert table[1]["spill_path"] == "/spill/1.npz"
+    assert table[1]["extra_ticks"] == 24  # cumulative resume budgets
+    assert table[1]["saved_run"] == {"ticks_run": 32}
+    assert table[2]["op"] == "cancelled"
+
+
+def test_journal_torn_tail_is_crash_point(tmp_path):
+    """A half-written last WAL line (crash mid-append) is where replay
+    stops — everything before it folds, nothing raises."""
+    from kaboodle_tpu.serve.journal import ServeJournal
+
+    j = ServeJournal(str(tmp_path / "j"))
+    j.append("submitted", 0, req={"n": 16})
+    j.append("submitted", 1, req={"n": 16})
+    j.close()
+    with open(os.path.join(str(tmp_path / "j"), "wal.jsonl"), "a") as f:
+        f.write('{"op": "harvested", "rid": 1, "resu')  # torn
+
+    table, next_rid = ServeJournal(str(tmp_path / "j")).replay()
+    assert next_rid == 2
+    assert table[1]["op"] == "submitted"  # the torn harvest never happened
+
+
+def test_journal_compaction_truncates_wal(tmp_path):
+    from kaboodle_tpu.serve.journal import ServeJournal
+
+    j = ServeJournal(str(tmp_path / "j"), compact_every=4)
+    for rid in range(5):
+        j.append("submitted", rid, req={"n": 16, "seed": rid})
+    assert j.should_compact()
+    table, next_rid = j.replay()
+    j.compact(table, next_rid)
+    assert not j.should_compact()
+    assert os.path.getsize(j.wal_path) == 0  # WAL cut after the snapshot
+    j.append("cancelled", 2)
+    j.close()
+
+    table2, next2 = ServeJournal(str(tmp_path / "j")).replay()
+    assert next2 == 5
+    assert {rid: row["op"] for rid, row in table2.items()} == {
+        0: "submitted", 1: "submitted", 2: "cancelled",
+        3: "submitted", 4: "submitted",
+    }
+
+
+# -- admission --------------------------------------------------------------
+
+
+def test_token_bucket_quota_and_retry_after():
+    from kaboodle_tpu.serve.admission import (
+        AdmissionController,
+        QuotaError,
+    )
+
+    clock = [0.0]
+    ctl = AdmissionController(
+        max_queue=8, quotas={"metered": (1.0, 2.0)},
+        clock=lambda: clock[0],
+    )
+    ctl.check_quota("metered")
+    ctl.check_quota("metered")  # burst of 2
+    with pytest.raises(QuotaError) as ei:
+        ctl.check_quota("metered")
+    assert ei.value.kind == "quota"
+    assert 0 < ei.value.retry_after_s <= 1.0
+    clock[0] += 1.0  # one token refilled
+    ctl.check_quota("metered")
+    # Unmetered tenants never throttle.
+    for _ in range(50):
+        ctl.check_quota("default")
+
+
+def test_queue_bound_and_retry_after_scales():
+    from kaboodle_tpu.serve.admission import (
+        AdmissionController,
+        QueueFullError,
+    )
+
+    ctl = AdmissionController(max_queue=4)
+    ctl.check_queue(3)
+    with pytest.raises(QueueFullError) as ei:
+        ctl.check_queue(4)
+    assert ei.value.kind == "queue_full"
+    assert ei.value.retry_after_s > 0
+    with pytest.raises(QueueFullError) as deeper:
+        ctl.check_queue(40)
+    assert deeper.value.retry_after_s > ei.value.retry_after_s
+
+
+def test_priority_preemption_spills_parked_victim(tmp_path):
+    """With every lane held by PARKED low-priority requests, a
+    higher-priority arrival evicts the least valuable one to disk
+    (running lanes are never preempted) and takes its lane."""
+    from kaboodle_tpu.serve.admission import AdmissionController
+
+    engine = ServeEngine(
+        [_pool(lanes=1)], warp=False, admission=AdmissionController(),
+        spill_dir=str(tmp_path), sync_spill=True,
+    )
+    engine.warmup()
+    low = engine.submit(ServeRequest(n=N, seed=3, mode="ticks", ticks=8,
+                                     scenario="steady", keep=True,
+                                     priority=0))
+    engine.drain()
+    assert engine.status(low)["state"] == "parked"
+    high = engine.submit(ServeRequest(n=N, seed=4, mode="ticks", ticks=8,
+                                      scenario="steady", priority=5))
+    engine.drain()
+    assert engine.status(high)["state"] == "done"
+    row = engine.status(low)
+    assert row["state"] == "spilled" and os.path.exists(row["spill_path"])
+    assert engine.restore(low)  # the preempted request is intact
+    engine.close()
+
+
+# -- spill manager ----------------------------------------------------------
+
+
+def test_spill_manager_failure_keeps_cache_then_retry(tmp_path):
+    from kaboodle_tpu.serve.spill import SpillManager
+    from kaboodle_tpu.sim.state import init_state
+    from kaboodle_tpu import checkpoint
+
+    member = init_state(8, seed=2)
+    path = str(tmp_path / "m.npz")
+    sp = SpillManager(depth=2)
+    try:
+        sp.fail_next(1)
+        assert sp.submit_write(7, path, member)
+        sp.flush()
+        (res,) = sp.poll()
+        assert not res.ok and "injected" in res.error
+        assert not os.path.exists(path)
+        assert sp.cached(7) is member  # the state survived the failure
+        assert sp.submit_write(7, path, sp.cached(7))
+        sp.flush()
+        (res2,) = sp.poll()
+        assert res2.ok
+        assert sp.cached(7) is None  # durable: the file supersedes it
+        assert _leaves_equal(member, checkpoint.load(path))
+    finally:
+        sp.close()
+
+
+def test_spill_manager_thunk_and_prefetch(tmp_path):
+    """A deferred (thunk) write materializes off the caller's thread, and
+    prefetch loads a file back into the cache for restore."""
+    from kaboodle_tpu.serve.spill import SpillManager
+    from kaboodle_tpu.sim.state import init_state
+
+    member = init_state(8, seed=5)
+    path = str(tmp_path / "t.npz")
+    sp = SpillManager(depth=2)
+    try:
+        calls = []
+
+        def thunk():
+            calls.append(1)
+            return member
+
+        assert sp.submit_write(1, path, thunk)
+        sp.flush()
+        assert sp.poll()[0].ok and calls
+        assert os.path.exists(path)
+
+        assert sp.cached(2) is None
+        assert sp.prefetch(2, path)
+        sp.flush()
+        assert sp.poll()[0].ok
+        assert _leaves_equal(member, sp.cached(2))
+    finally:
+        sp.close()
+
+
+# -- engine recovery --------------------------------------------------------
+
+
+def test_recover_requeues_reattaches_and_compacts(tmp_path):
+    """A journaled engine abandoned mid-service (no close — a crash)
+    recovers into a fresh engine: the completed request keeps its result,
+    the spilled one re-attaches to its file, the in-flight one re-queues
+    and re-runs; the journal is compacted on the way in."""
+    jdir, sdir = str(tmp_path / "j"), str(tmp_path / "s")
+    os.makedirs(sdir)
+
+    def build(**kw):
+        e = ServeEngine([_pool(lanes=2)], warp=False, sync_spill=True,
+                        journal_dir=jdir, spill_dir=sdir, **kw)
+        e.warmup()
+        return e
+
+    victim = build(spill_after=0)
+    kept = victim.submit(ServeRequest(n=N, seed=13, mode="ticks", ticks=16,
+                                      scenario="steady", keep=True))
+    done = victim.submit(ServeRequest(n=N, seed=2, mode="converge", ticks=40))
+    flight = victim.submit(ServeRequest(n=N, seed=5, mode="ticks", ticks=800,
+                                        scenario="steady"))
+    for _ in range(200):
+        victim.step()
+        if (victim.status(kept)["state"] == "spilled"
+                and victim.status(done)["state"] == "done"):
+            break
+    else:
+        raise AssertionError("kill point never reached")
+    done_result = victim.status(done)["result"]
+    del victim  # the crash: no close, no flush
+
+    rec = build()
+    counts = rec.recover()
+    assert counts == {"done": 1, "spilled": 1, "requeued": 1,
+                      "cancelled": 0, "dropped": 0}
+    assert rec.status(done)["state"] == "done"
+    assert rec.status(done)["result"] == done_result  # replayed never
+    assert rec.status(kept)["state"] == "spilled"
+    assert rec.restore(kept)
+    assert rec.status(flight)["state"] == "queued"
+    rec.drain()
+    assert rec.status(flight)["result"]["ticks_run"] == 800
+    # Recovery compacted: the WAL holds only post-recovery transitions.
+    with open(os.path.join(jdir, "wal.jsonl")) as f:
+        ops = [json.loads(line) for line in f if line.strip()]
+    assert not any(r["rid"] == done for r in ops)
+    rec.close()
+
+
+def test_recover_refuses_live_table_and_requires_journal(tmp_path):
+    engine = ServeEngine([_pool()], warp=False)
+    with pytest.raises(ValueError, match="journal_dir"):
+        engine.recover()
+    j = ServeEngine([_pool()], warp=False,
+                    journal_dir=str(tmp_path / "j"))
+    j.submit(ServeRequest(n=N, seed=1, mode="ticks", ticks=8,
+                          scenario="steady"))
+    with pytest.raises(ValueError, match="empty"):
+        j.recover()
+    j.close()
+
+
+# -- server structured errors + client timeout/retry ------------------------
+
+
+def test_server_structured_errors_keep_connection_alive():
+    """Malformed JSON, non-object ops, unknown ops and bad arguments all
+    come back as ``{"ok": false, "kind": ...}`` responses on a connection
+    that keeps serving — and the client surfaces them as ServeError with
+    the kind attached."""
+    from kaboodle_tpu.serve.client import ServeClient, ServeError
+    from kaboodle_tpu.serve.server import ServeServer
+
+    engine = ServeEngine([_pool()], warp=False)
+    server = ServeServer(engine, port=0)
+    engine.warmup()
+
+    async def drive() -> None:
+        await server.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       server.port)
+        for bad_line in (b"this is not json\n", b'[1, 2, 3]\n',
+                         b'{"op": "no-such-op"}\n'):
+            writer.write(bad_line)
+            await writer.drain()
+            resp = json.loads(await reader.readline())
+            assert resp["ok"] is False
+            assert resp["kind"] == "bad_request", resp
+        # ...and the SAME connection still serves real ops.
+        writer.write(json.dumps({"op": "stats"}).encode() + b"\n")
+        await writer.drain()
+        assert json.loads(await reader.readline())["ok"] is True
+        writer.close()
+
+        client = await ServeClient.connect(port=server.port)
+        with pytest.raises(ServeError) as ei:
+            await client.wait(999, timeout=5.0)
+        assert ei.value.kind == "bad_request"
+        with pytest.raises(ServeError) as ei:
+            await client.restore(999, timeout=5.0)
+        assert ei.value.kind == "bad_request"
+        await client.shutdown()
+        await server.close()
+
+    asyncio.run(drive())
+
+
+def test_client_timeout_desyncs_and_retry_backoff_rides_queue_full():
+    """A timed-out wait raises builtin TimeoutError and poisons that
+    connection (request/response pairing is broken); a fresh client works.
+    submit(retries=) rides queue_full rejections with the server's
+    retry-after until capacity frees."""
+    from kaboodle_tpu.serve.admission import AdmissionController
+    from kaboodle_tpu.serve.client import ServeClient, ServeError
+    from kaboodle_tpu.serve.server import ServeServer
+
+    engine = ServeEngine(
+        [_pool(lanes=1)], warp=False,
+        admission=AdmissionController(max_queue=1),
+    )
+    server = ServeServer(engine, port=0)
+    engine.warmup()
+
+    async def drive() -> None:
+        await server.start()
+        client = await ServeClient.connect(port=server.port)
+        long = await client.submit(N, seed=1, mode="ticks", ticks=4000,
+                                   scenario="steady")
+        with pytest.raises(TimeoutError):
+            await client.wait(long, timeout=0.05)
+        with pytest.raises(ConnectionError, match="desynchronized"):
+            await client.stats()
+        await client.close()
+
+        client = await ServeClient.connect(port=server.port)
+        queued = await client.submit(N, seed=2, mode="ticks", ticks=8,
+                                     scenario="steady")
+        with pytest.raises(ServeError) as ei:  # lane + queue slot both held
+            await client.submit(N, seed=3, mode="ticks", ticks=8,
+                                scenario="steady")
+        assert ei.value.kind == "queue_full"
+        assert ei.value.retry_after_s > 0
+
+        async def free_capacity() -> None:
+            await asyncio.sleep(0.05)
+            c = await ServeClient.connect(port=server.port)
+            assert await c.cancel(queued)
+            assert await c.cancel(long)
+            await c.close()
+
+        freer = asyncio.create_task(free_capacity())
+        rid = await client.submit(N, seed=4, mode="ticks", ticks=8,
+                                  scenario="steady", retries=10,
+                                  backoff=0.05)
+        await freer
+        row = await client.wait(rid, timeout=30.0)
+        assert row["state"] == "done"
+        await client.shutdown()
+        await server.close()
+
+    asyncio.run(drive())

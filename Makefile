@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -65,6 +65,7 @@ ci: lint native test
 	$(MAKE) phasegraph-dryrun
 	$(MAKE) serve-dryrun
 	$(MAKE) serve-chaos-dryrun
+	$(MAKE) serve-obs-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -145,6 +146,20 @@ serve-dryrun:
 # (PERF.md "Serving under overload", BENCH_serve_overload.json).
 serve-chaos-dryrun:
 	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --chaos-dryrun
+
+# Servescope dryrun (observability plane, ISSUE 14): the traced-lifecycle
+# CI lane — obs-enabled engine + server with manifest and Prometheus
+# endpoint, 8 mixed requests through admit/leap/park/spill/restore/resume/
+# cancel, asserting from the inside: zero fresh compiles with the plane
+# attached (KB405 counter AND the plane's own compiles_steady gauge), the
+# metrics RPC + HTTP scrape serve the expected families, the manifest
+# passes --check / --serve-report / the Perfetto export (journal track
+# included), and an obs-on vs obs-off A/B over the identical scripted
+# workload ends bit-exact with <= 5% median busy-round overhead. The
+# banked SLO-attribution curves come from
+# `python -m kaboodle_tpu serve-load --slo` (PERF.md, BENCH_serve_slo.json).
+serve-obs-dryrun:
+	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --obs-dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

@@ -129,6 +129,21 @@ class AdmissionController:
             f"tenant {tenant!r} over quota", retry_after_s=b.retry_after()
         )
 
+    def snapshot(self) -> dict:
+        """Point-in-time view for the metrics plane: queue bound plus the
+        live token balance per tenant (refilled first, so the gauge reads
+        what ``try_take`` would see). Only tenants that have actually
+        submitted appear — buckets are lazily materialized."""
+        tenants = {}
+        for tenant, b in self._buckets.items():
+            b._refill()
+            tenants[tenant] = {
+                "tokens": b._tokens,
+                "rate": b.rate,
+                "burst": b.burst,
+            }
+        return {"max_queue": self.max_queue, "tenants": tenants}
+
     def check_queue(self, queued: int) -> None:
         """Raise :class:`QueueFullError` when the queue is at capacity.
 

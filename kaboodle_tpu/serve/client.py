@@ -160,6 +160,13 @@ class ServeClient:
         resp = await self._rpc(timeout=timeout, op="stats")
         return resp["stats"]
 
+    async def metrics(self, timeout: float | None = None) -> dict:
+        """The metrics-plane snapshot (``MetricsRegistry.collect()``):
+        counters, pull-gauges and histograms. Requires a server started
+        with ``--obs`` — otherwise a ``bad_request`` ServeClientError."""
+        resp = await self._rpc(timeout=timeout, op="metrics")
+        return resp["metrics"]
+
     async def shutdown(self) -> None:
         self._writer.write(json.dumps({"op": "shutdown"}).encode() + b"\n")
         await self._writer.drain()

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import OrderedDict
 
 import jax.numpy as jnp
@@ -66,6 +67,16 @@ import numpy as np
 
 from kaboodle_tpu.errors import CheckpointError
 from kaboodle_tpu.serve.admission import AdmissionError
+from kaboodle_tpu.serve.obsplane import (
+    SEG_ADMIT,
+    SEG_DISPATCH,
+    SEG_HARVEST,
+    SEG_JOURNAL,
+    SEG_POLL,
+    SEG_ROUND,
+    SEG_SPILL,
+    ObsPlane,
+)
 from kaboodle_tpu.serve.pool import LanePool, lane_n_class
 from kaboodle_tpu.telemetry.manifest import run_record
 from kaboodle_tpu.warp.horizon import decode_signature
@@ -165,6 +176,7 @@ class ServeEngine:
         sync_spill: bool = False,
         spill_depth: int = 4,
         spills_per_round: int = 1,
+        obs=None,
     ) -> None:
         self.pools: dict[int, LanePool] = {}
         for pool in pools:
@@ -203,6 +215,17 @@ class ServeEngine:
         self._requests: OrderedDict[int, dict] = OrderedDict()
         # (n_class, lane) -> rid for lanes currently occupied by a request.
         self._lane_owner: dict[tuple[int, int], int] = {}
+        # Observability plane (ISSUE 14): obs=True gets the defaults,
+        # obs=ObsPlane(...) a configured one. The plane is a pure observer
+        # — engine state is bit-identical with it on or off — and its
+        # epoch is shared with the journal so WAL ts_us and span t0_us
+        # live on one monotonic timeline.
+        self.obs: ObsPlane | None = None
+        if obs:
+            self.obs = obs if isinstance(obs, ObsPlane) else ObsPlane()
+            self.obs.bind(self)
+            if self.journal is not None:
+                self.journal.epoch_ns = self.obs.epoch_ns
 
     @property
     def spiller(self):
@@ -218,12 +241,38 @@ class ServeEngine:
             self._spiller.flush()
             self._poll_spills()
             self._spiller.close()
+        if self.obs is not None:
+            for rec in self.obs.flush_spans():
+                if self.on_event is not None:
+                    self.on_event(rec)
+            self.obs.close()
         if self.journal is not None:
             self.journal.close()
 
     def _log(self, op: str, rid: int, **fields) -> None:
-        if self.journal is not None:
+        if self.journal is None:
+            return
+        if self.obs is not None:
+            t0 = time.perf_counter_ns()
             self.journal.append(op, rid, **fields)
+            self.obs.profiler.add_ns(
+                SEG_JOURNAL, time.perf_counter_ns() - t0
+            )
+        else:
+            self.journal.append(op, rid, **fields)
+
+    def _span(self, rid: int, span: str | None, pool_n: int = -1,
+              lane: int = -1, **extra) -> None:
+        """One lifecycle edge on the tracer: closes the request's open
+        span (fanning the ``serve_span`` record out to the stream and
+        manifest) and opens the next (None = terminal). No-op without an
+        observability plane."""
+        if self.obs is None:
+            return
+        rec = self.obs.transition(rid, span, pool_n=pool_n, lane=lane,
+                                  **extra)
+        if rec is not None and self.on_event is not None:
+            self.on_event(rec)
 
     # -- request surface ---------------------------------------------------
 
@@ -265,6 +314,7 @@ class ServeEngine:
             "serve_event", event="submitted", request_id=rid, pool_n=n_class,
             lane=-1, tenant=req.tenant, priority=req.priority,
         )
+        self._span(rid, "queued", pool_n=n_class)
         return rid
 
     def _admission_gate(self, req: ServeRequest) -> None:
@@ -292,6 +342,7 @@ class ServeEngine:
             "serve_event", event="shed", request_id=rid, pool_n=row["pool"],
             lane=-1, tenant=row["req"].tenant, priority=row["req"].priority,
         )
+        self._span(rid, None, fate="shed")
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request in any non-terminal state; frees its lane."""
@@ -310,6 +361,7 @@ class ServeEngine:
         self._log("cancelled", rid)
         self._emit("serve_event", event="cancelled", request_id=rid,
                    pool_n=row["pool"], lane=-1)
+        self._span(rid, None, fate="cancelled")
         return True
 
     def status(self, rid: int | None = None):
@@ -370,6 +422,7 @@ class ServeEngine:
                        event="preempted" if evict else "spilled",
                        request_id=rid, pool_n=row["pool"], lane=lane,
                        path=path)
+            self._span(rid, "spilled", pool_n=row["pool"])
             return True
         # A thunk binding the warmed gather to the current (immutable)
         # mesh snapshot: the writer thread executes the gather and the
@@ -388,8 +441,10 @@ class ServeEngine:
             row.update(state=SPILLED, lane=None)
             self._emit("serve_event", event="preempted", request_id=rid,
                        pool_n=row["pool"], lane=lane, path=path)
+            self._span(rid, "spilling", pool_n=row["pool"])
         else:
             row["state"] = SPILLING
+            self._span(rid, "spilling", pool_n=row["pool"], lane=lane)
         return True
 
     def _poll_spills(self) -> None:
@@ -417,12 +472,14 @@ class ServeEngine:
                     self._emit("serve_event", event="spilled",
                                request_id=res.rid, pool_n=row["pool"],
                                lane=lane, path=res.path)
+                    self._span(res.rid, "spilled", pool_n=row["pool"])
                 elif row["state"] == SPILLED:
                     self._log("spilled", res.rid, path=res.path,
                               saved_run=row["saved_run"])
                     self._emit("serve_event", event="spilled",
                                request_id=res.rid, pool_n=row["pool"],
                                lane=-1, path=res.path)
+                    self._span(res.rid, "spilled", pool_n=row["pool"])
                 # restored/cancelled while in flight: the file is a
                 # harmless stale snapshot; nothing to transition.
             elif row["state"] == SPILLING:
@@ -434,6 +491,8 @@ class ServeEngine:
                 self._emit("serve_event", event="spill_failed",
                            request_id=res.rid, pool_n=row["pool"],
                            lane=row["lane"], error=res.error)
+                self._span(res.rid, "parked", pool_n=row["pool"],
+                           lane=row["lane"], fate="spill_failed")
             elif row["state"] == SPILLED:
                 row["retry_spill"] = True
                 self._log("spill_failed", res.rid, path=res.path,
@@ -508,6 +567,8 @@ class ServeEngine:
         self._emit("serve_event", event="restored", request_id=rid,
                    pool_n=row["pool"], lane=lane,
                    generation=row["generation"])
+        self._span(rid, "parked", pool_n=row["pool"], lane=lane,
+                   fate="restored")
         return True
 
     def resume(self, rid: int, mode: str = "ticks", ticks: int = 16) -> None:
@@ -528,6 +589,7 @@ class ServeEngine:
         self._emit("serve_event", event="resumed", request_id=rid,
                    pool_n=row["pool"], lane=row["lane"], mode=mode,
                    ticks=int(ticks))
+        self._span(rid, "running", pool_n=row["pool"], lane=row["lane"])
 
     # -- crash recovery ----------------------------------------------------
 
@@ -588,12 +650,20 @@ class ServeEngine:
             self._requests[rid] = row
         self._next_rid = max(self._next_rid, next_rid)
         self.journal.compact(table, self._next_rid)
+        # Re-queue in journal order (the WAL's sequence numbers; rid as
+        # the pre-seq fallback), so recovery admission replays the order
+        # the crashed engine actually witnessed.
+        requeued.sort(key=lambda rid: (table[rid].get("seq", rid), rid))
         for rid in requeued:
             self._log("requeued", rid)
             self._emit_standalone(
                 "serve_event", event="requeued", request_id=rid,
                 pool_n=self._requests[rid]["pool"], lane=-1,
             )
+            self._span(rid, "queued", pool_n=self._requests[rid]["pool"])
+        for rid, row in self._requests.items():
+            if row["state"] == SPILLED:
+                self._span(rid, "spilled", pool_n=row["pool"])
         self._emit_standalone(
             "serve_event", event="recovered", request_id=-1, lane=-1,
             pool_n=min(self.pools), **counts,
@@ -622,22 +692,52 @@ class ServeEngine:
         pool, harvest, spill. Never blocks on disk.
 
         Returns the manifest records emitted this round (also fanned out
-        through ``on_event`` as they happen)."""
+        through ``on_event`` as they happen). With an observability plane
+        attached the loop sections are bracketed by monotonic-clock laps
+        (preallocated accumulators — no allocation per round) and, when
+        tracing, the finished round is emitted as one ``round`` span
+        carrying the segment split."""
+        obs = self.obs
+        prof = obs.profiler if obs is not None else None
         self._events = []
+        if prof is not None:
+            t = prof.round_begin()
+            t0_us = obs.now_us()
         self._poll_spills()
         self._retry_spills()
+        if prof is not None:
+            t = prof.lap(SEG_POLL, t)
         self._admit_queued()
+        if prof is not None:
+            t = prof.lap(SEG_ADMIT, t)
         for pool in self.pools.values():
             if not pool.active.any():
                 continue
             if not self._try_leap_round(pool):
                 self._chunk_round(pool)
+            if prof is not None:
+                t = prof.lap(SEG_DISPATCH, t)
             self._harvest(pool)
+            if prof is not None:
+                t = prof.lap(SEG_HARVEST, t)
         self._spill_idle()
+        if prof is not None:
+            t = prof.lap(SEG_SPILL, t)
+        rnd = self.round
         self.round += 1
         if self.journal is not None and self.journal.should_compact():
             table, next_rid = self.journal.replay()
             self.journal.compact(table, max(next_rid, self._next_rid))
+        if prof is not None:
+            prof.lap(SEG_JOURNAL, t)
+            prof.round_end()
+            if obs.trace:
+                self._emit(
+                    "serve_span", span="round", request_id=-1, pool_n=-1,
+                    lane=-1, round=rnd, t0_us=t0_us,
+                    dur_us=int(prof.last_us[SEG_ROUND]),
+                    segments=prof.last_segments(),
+                )
         return self._events
 
     def drain(self, max_rounds: int = 10_000) -> list[dict]:
@@ -680,6 +780,7 @@ class ServeEngine:
                        pool_n=row["pool"], lane=lane,
                        generation=row["generation"], seed=req.seed,
                        mode=req.mode, scenario=req.scenario)
+            self._span(rid, "running", pool_n=row["pool"], lane=lane)
 
     def _preempt_for(self, row: dict) -> bool:
         """Spill-evict one strictly lower-priority PARKED lane of this
@@ -716,6 +817,8 @@ class ServeEngine:
         # int64 vector would dispatch a fresh convert_element_type program
         # and break the zero-recompile contract.
         k_m = np.zeros((pool.lanes,), dtype=np.int32)
+        tracing = self.obs is not None and self.obs.trace
+        classes: list[dict] = []
         for e in np.flatnonzero(horizon):
             cls = decode_signature(rows[e])
             mode = _classify(cls, hybrid=True)
@@ -724,26 +827,57 @@ class ServeEngine:
                     _leap_budget(cls, mode, int(pool.remaining[e])),
                     self.max_leap,
                 )
+            if tracing:
+                classes.append({
+                    "lane": int(e), "k": int(k_m[e]), "mode": mode,
+                    "class_key": cls.key, "terms": cls.describe()["terms"],
+                })
         if k_m.max() < MIN_LEAP:
             return False
         K = 1 << int(k_m.max() - 1).bit_length()
         K = max(K, MIN_LEAP)
+        if tracing:
+            t0_us = self.obs.now_us()
         pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, jnp.asarray(k_m))
         pool.advance_leaped(k_m)
         self._emit(
             "serve_round", round=self.round, pool_n=pool.n, engine="leap",
             lanes=int((k_m > 0).sum()), ticks=int(k_m.sum()), bucket=K,
         )
+        if tracing:
+            # One advance span per pool round, each leaping lane annotated
+            # with its Warp 2.0 signature class — the trace exporter fans
+            # these onto the per-lane tracks. dur_us is dispatch wall time
+            # (the round loop's view; device compute is asynchronous).
+            self._emit(
+                "serve_span", span="advance", request_id=-1, pool_n=pool.n,
+                lane=-1, t0_us=t0_us, dur_us=self.obs.now_us() - t0_us,
+                round=self.round, engine="leap", bucket=K, classes=classes,
+            )
         return True
 
     def _chunk_round(self, pool: LanePool) -> None:
         prev = pool.ticks_run.copy()
+        tracing = self.obs is not None and self.obs.trace
+        if tracing:
+            t0_us = self.obs.now_us()
+            active = [int(e) for e in np.flatnonzero(pool.active)]
         pool.step()
         self._emit(
             "serve_round", round=self.round, pool_n=pool.n, engine="chunk",
             lanes=int(pool.active.sum()),
             ticks=int((pool.ticks_run - prev).sum()),
         )
+        if tracing:
+            self._emit(
+                "serve_span", span="advance", request_id=-1, pool_n=pool.n,
+                lane=-1, t0_us=t0_us, dur_us=self.obs.now_us() - t0_us,
+                round=self.round, engine="chunk",
+                classes=[
+                    {"lane": e, "k": int(pool.ticks_run[e] - prev[e])}
+                    for e in active
+                ],
+            )
 
     def _harvest(self, pool: LanePool) -> None:
         finished = pool.active & (
@@ -786,10 +920,14 @@ class ServeEngine:
                 pool.park(lane)
                 row["state"] = PARKED
                 row["idle_rounds"] = 0
+                self._span(rid, "parked", pool_n=pool.n, lane=lane,
+                           fate=event, ticks_run=result["ticks_run"])
             else:
                 pool.release(lane)
                 del self._lane_owner[(pool.n, lane)]
                 row.update(state=DONE, lane=None)
+                self._span(rid, None, fate=event,
+                           ticks_run=result["ticks_run"])
 
     def _spill_idle(self) -> None:
         if self.spill_after is None or self.spill_dir is None:
@@ -828,6 +966,10 @@ class ServeEngine:
             "serve_event", event="warm", request_id=-1, lane=-1,
             pool_n=min(self.pools), pools=sorted(self.pools),
         )
+        if self.obs is not None:
+            # Warmup compiles are the budgeted ones; from here on the
+            # ``compiles_steady`` gauge counts contract violations.
+            self.obs.reset_compiles()
 
     # -- events ------------------------------------------------------------
 
@@ -837,7 +979,15 @@ class ServeEngine:
         return rec
 
     def _emit_standalone(self, kind: str, **fields) -> dict:
-        rec = run_record(kind, **fields)
+        if self.obs is not None:
+            if self.obs.trace and "t_us" not in fields:
+                # Wall-clock stamp (plane-epoch us) so lifecycle events
+                # land on the same trace timeline as the spans.
+                fields["t_us"] = self.obs.now_us()
+            rec = run_record(kind, **fields)
+            self.obs.on_record(rec)
+        else:
+            rec = run_record(kind, **fields)
         if self.on_event is not None:
             self.on_event(rec)
         return rec

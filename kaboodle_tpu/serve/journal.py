@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 JOURNAL_VERSION = 1
 
@@ -59,12 +60,48 @@ class ServeJournal:
         self.fsync = bool(fsync)
         self.compact_every = int(compact_every)
         self._appends_since_compact = 0
+        # Timeline origin for ``ts_us`` stamps. The engine overwrites this
+        # with the observability plane's epoch after construction so journal
+        # timestamps and trace spans share one monotonic axis.
+        self.epoch_ns = time.monotonic_ns()
+        self._seq = self._restore_seq()
         self._f = open(self.wal_path, "a")
+
+    def _restore_seq(self) -> int:
+        """Resume the sequence counter past everything already on disk, so
+        seq stays strictly increasing across process restarts (pre-seq
+        records simply don't participate in the max)."""
+        seq = 0
+        if os.path.exists(self.manifest_path):
+            try:
+                with open(self.manifest_path) as f:
+                    seq = int(json.load(f).get("next_seq", 0))
+            except (json.JSONDecodeError, ValueError, OSError):
+                seq = 0
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail: same tolerance as replay()
+                    if "seq" in rec:
+                        seq = max(seq, int(rec["seq"]) + 1)
+        return seq
 
     # -- write side --------------------------------------------------------
 
     def append(self, op: str, rid: int, **fields) -> None:
-        rec = {"op": op, "rid": int(rid)}
+        rec = {
+            "op": op,
+            "rid": int(rid),
+            "seq": self._seq,
+            "ts_us": (time.monotonic_ns() - self.epoch_ns) // 1000,
+        }
+        self._seq += 1
         rec.update(fields)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
@@ -85,6 +122,7 @@ class ServeJournal:
             {
                 "version": JOURNAL_VERSION,
                 "next_rid": int(next_rid),
+                "next_seq": self._seq,
                 "requests": {str(rid): row for rid, row in table.items()},
             },
         )
@@ -148,6 +186,12 @@ class ServeJournal:
         )
         op = rec["op"]
         row["op"] = op
+        # Ordering metadata (absent from pre-seq journals; recover() falls
+        # back to rid order when missing).
+        if "seq" in rec:
+            row["seq"] = int(rec["seq"])
+        if "ts_us" in rec:
+            row["ts_us"] = int(rec["ts_us"])
         if op == "submitted":
             row["req"] = rec.get("req")
         elif op == "harvested":
@@ -163,3 +207,28 @@ class ServeJournal:
             row["saved_run"] = rec.get("saved_run")
         elif op == "spill_failed":
             pass  # lane still held (or cache retried); last op stands
+
+
+def read_journal_records(journal_dir: str) -> list[dict]:
+    """Raw WAL records in replay order, for the trace exporter.
+
+    Records carrying ``seq`` (post-PR-14 journals) are ordered by it —
+    that is the crash-recovery order even when compaction interleaved
+    writes.  Pre-seq records keep file order (stable sort, missing seq
+    sorts first in encounter order).  Torn tails are skipped exactly like
+    :meth:`ServeJournal.replay`.
+    """
+    path = os.path.join(os.fspath(journal_dir), "wal.jsonl")
+    records: list[dict] = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break
+    records.sort(key=lambda r: int(r.get("seq", -1)))
+    return records

@@ -25,9 +25,19 @@ admission-control study: a closed-loop calibration measures capacity, then
 open-loop phases offer 2x / 5x / 10x that rate with mixed tenants and
 priorities against a bounded queue. Submits are pipelined raw (a rejection
 is a response, not an exception), so the offered schedule really is
-open-loop; the report banks goodput, shed rate and admitted-latency
-percentiles per phase — the overload curves — plus the same
-``compiles_steady == 0`` pin across every phase.
+open-loop; the report banks goodput, shed rate (aggregate and broken down
+per tenant and per priority class) and admitted-latency percentiles per
+phase — the overload curves — plus the same ``compiles_steady == 0`` pin
+across every phase.
+
+``--slo`` (BENCH_serve_slo.json) is the servescope campaign (ROADMAP item
+1(d)): an observability-enabled server takes open-loop waves at multiples
+of calibrated capacity, and every level banks latency percentiles plus
+*where the time went* — per-request queue/run span percentiles from the
+traced manifest and the round profiler's admit/dispatch/harvest/spill/
+journal segment deltas from the ``metrics`` RPC — alongside a Perfetto
+trace artifact showing lanes, leaps, spills and journal writes on one
+timeline.
 """
 
 from __future__ import annotations
@@ -129,16 +139,32 @@ async def _overload_phase(client_factory, port: int, n: int,
     lat: list[float] = []
     waiters: list[asyncio.Task] = []
     counts = {"completed": 0, "shed": 0, "rejected": 0}
+    # Per-class fate breakdown: submit i's tenant/priority are functions
+    # of i (the offer loop's mix), so every response and waiter outcome
+    # attributes deterministically without echoing fields over the wire.
+    by_tenant: dict[str, dict] = {}
+    by_priority: dict[str, dict] = {}
 
-    async def complete(rid: int, t0: float) -> None:
+    def _classes(i: int) -> tuple[dict, dict]:
+        zero = {"offered": 0, "rejected": 0, "shed": 0, "completed": 0}
+        return (by_tenant.setdefault(f"t{i % 3}", dict(zero)),
+                by_priority.setdefault(str(i % 3), dict(zero)))
+
+    def _count(i: int, fate: str) -> None:
+        for bucket in _classes(i):
+            bucket[fate] += 1
+
+    async def complete(i: int, rid: int, t0: float) -> None:
         c = await client_factory()
         try:
             row = await c.wait(rid)
             if row["state"] == "done":
                 counts["completed"] += 1
+                _count(i, "completed")
                 lat.append(time.perf_counter() - t0)
             else:
                 counts["shed"] += 1
+                _count(i, "shed")
         finally:
             await c.close()
 
@@ -149,9 +175,10 @@ async def _overload_phase(client_factory, port: int, n: int,
                 # submit_t[i] exists: the server can only respond to a
                 # line written after its timestamp was appended.
                 waiters.append(asyncio.create_task(
-                    complete(resp["request_id"], submit_t[i])))
+                    complete(i, resp["request_id"], submit_t[i])))
             else:
                 counts["rejected"] += 1
+                _count(i, "rejected")
 
     async def offer() -> None:
         start = time.perf_counter()
@@ -162,6 +189,7 @@ async def _overload_phase(client_factory, port: int, n: int,
                 await asyncio.sleep(delay)
             op = {"op": "submit", "n": n, "tenant": f"t{i % 3}",
                   "priority": i % 3, **_mix_fields(i)}
+            _count(i, "offered")
             submit_t.append(time.perf_counter())
             writer.write(json.dumps(op).encode() + b"\n")
         await writer.drain()
@@ -172,6 +200,13 @@ async def _overload_phase(client_factory, port: int, n: int,
     elapsed = time.perf_counter() - t0
     writer.close()
     admitted = requests - counts["rejected"]
+
+    def _finish(buckets: dict[str, dict]) -> dict:
+        for b in buckets.values():
+            b["shed_rate"] = round(
+                (b["rejected"] + b["shed"]) / max(b["offered"], 1), 3)
+        return dict(sorted(buckets.items()))
+
     return {
         "offered_rps": round(rate, 2),
         "requests": requests,
@@ -182,8 +217,232 @@ async def _overload_phase(client_factory, port: int, n: int,
         "goodput_rps": round(counts["completed"] / elapsed, 2),
         "shed_rate": round(
             (counts["rejected"] + counts["shed"]) / requests, 3),
+        "by_tenant": _finish(by_tenant),
+        "by_priority": _finish(by_priority),
         "elapsed_s": round(elapsed, 3),
         "latency": _latency_stats(lat) if lat else None,
+    }
+
+
+async def _slo_level(client_factory, n: int, requests: int, rate: float):
+    """One open-loop SLO level: like :func:`_open_loop` but keeps the rid
+    of every submit, so the post-run manifest pass can attribute each
+    level's queue/run time from its own span records."""
+    lat: list[float] = []
+    rids: list[int] = []
+    client = await client_factory()
+    waiters: list[asyncio.Task] = []
+
+    async def complete(rid: int, t0: float) -> None:
+        c = await client_factory()
+        try:
+            await c.wait(rid)
+            lat.append(time.perf_counter() - t0)
+        finally:
+            await c.close()
+
+    start = time.perf_counter()
+    try:
+        for i in range(requests):
+            delay = start + i / rate - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            t0 = time.perf_counter()
+            rid = await client.submit(n, **_mix_fields(i))
+            rids.append(rid)
+            waiters.append(asyncio.create_task(complete(rid, t0)))
+        await asyncio.gather(*waiters)
+    finally:
+        await client.close()
+    return lat, time.perf_counter() - start, rids
+
+
+def _span_stats(spans: list[dict], rids: set[int], phase: str) -> dict | None:
+    durs = sorted(
+        int(s["dur_us"]) for s in spans
+        if int(s["request_id"]) in rids and s["span"] == phase
+    )
+    if not durs:
+        return None
+    pick = lambda q: durs[min(int(q * len(durs)), len(durs) - 1)]  # noqa: E731
+    return {"count": len(durs), "p50_us": pick(0.50), "p90_us": pick(0.90),
+            "p99_us": pick(0.99), "max_us": durs[-1],
+            "total_us": sum(durs)}
+
+
+def _segment_totals(metrics: dict) -> dict[str, int]:
+    hists = metrics["histograms"].get("serve_round_segment_us", {})
+    return {
+        key.split("=", 1)[1]: int(snap["total_us"])
+        for key, snap in hists.items()
+    }
+
+
+async def _run_slo(args) -> dict:
+    """The SLO-attribution campaign (``--slo``): ROADMAP item 1(d).
+
+    An obs-enabled server (tracing + profiler + metrics + journal +
+    spill) takes a closed-loop calibration, then open-loop waves at
+    ``--slo-levels`` multiples of measured capacity. Each level banks its
+    latency percentiles AND where the time went, from two independent
+    instruments: per-request ``queued``/``running`` span percentiles out
+    of the manifest, and the round profiler's segment totals (admit /
+    dispatch / harvest / spill / journal) deltaed over the level via the
+    ``metrics`` RPC. A keep-wave parks lanes so the trace artifact shows
+    spill + restore + journal activity on the shared timeline; the whole
+    steady phase runs under the KB405 compile gate."""
+    import os
+    import tempfile
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.engine import ServeEngine
+    from kaboodle_tpu.serve.obsplane import ObsPlane
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.serve.server import ServeServer
+
+    assert_counter_live()
+    base = args.out[:-5] if args.out.endswith(".json") else args.out
+    manifest_path = f"{base}.manifest.jsonl"
+    trace_path = f"{base}.trace.json"
+    scratch = tempfile.mkdtemp(prefix="kaboodle-slo-")
+    os.makedirs(os.path.join(scratch, "spill"), exist_ok=True)
+    pool = LanePool(args.n, args.lanes, chunk=args.chunk)
+    engine = ServeEngine(
+        [pool], warp=not args.no_warp, max_leap=args.max_leap,
+        spill_after=2, spill_dir=os.path.join(scratch, "spill"),
+        journal_dir=os.path.join(scratch, "journal"),
+        obs=ObsPlane(trace=True),
+    )
+    server = ServeServer(engine, port=0, manifest_path=manifest_path)
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    await server.start()
+
+    async def client_factory():
+        return await ServeClient.connect(port=server.port)
+
+    warm_client = await client_factory()
+    for i in range(2 * args.lanes):
+        rid = await warm_client.submit(args.n, **_mix_fields(i))
+        await warm_client.wait(rid)
+    await warm_client.close()
+
+    levels: dict[str, dict] = {}
+    with compile_counter() as box:
+        cal_lat, cal_s = await _closed_loop(
+            client_factory, args.n, args.requests, args.concurrency
+        )
+        capacity_rps = len(cal_lat) / cal_s
+        # Keep-wave: parked lanes that idle out and spill mid-campaign,
+        # putting spill + journal events on the trace timeline.
+        keeper = await client_factory()
+        kept = []
+        for i in range(2):
+            rid = await keeper.submit(args.n, seed=100 + i, mode="ticks",
+                                      ticks=8, scenario="steady", keep=True)
+            kept.append(rid)
+            await keeper.wait(rid)
+        probe = await client_factory()
+        for mult in args.slo_levels:
+            before = _segment_totals(await probe.metrics())
+            lat, elapsed, rids = await _slo_level(
+                client_factory, args.n, args.requests,
+                rate=capacity_rps * mult,
+            )
+            after = _segment_totals(await probe.metrics())
+            levels[f"{mult:g}x"] = {
+                "offered_rps": round(capacity_rps * mult, 2),
+                "requests": len(lat),
+                "elapsed_s": round(elapsed, 3),
+                "throughput_rps": round(len(lat) / elapsed, 2),
+                "latency": _latency_stats(lat),
+                "rids": rids,
+                "segments_us": {
+                    seg: after.get(seg, 0) - before.get(seg, 0)
+                    for seg in after
+                },
+            }
+        # Bring one kept lane back through restore->resume so the trace
+        # shows the full spilled->parked->running arc.
+        for rid in kept:
+            row = await keeper.status(rid)
+            if row and row["state"] == "spilled":
+                await keeper.restore(rid)
+                await keeper.resume(rid, mode="ticks", ticks=4)
+                await keeper.wait(rid)
+                break
+        await keeper.close()
+    compiles = box.count
+
+    final_metrics = await probe.metrics()
+    await probe.shutdown()
+    await server.close()
+
+    # Post-run: per-level queue/run attribution from the span records the
+    # server streamed to the manifest, then the shared-timeline trace.
+    from kaboodle_tpu.serve.journal import read_journal_records
+    from kaboodle_tpu.telemetry.manifest import read_manifest
+    from kaboodle_tpu.telemetry.trace import (
+        journal_trace_events,
+        serve_trace_events,
+        write_chrome_trace,
+    )
+
+    records = list(read_manifest(manifest_path))
+    spans = [r for r in records if r["kind"] == "serve_span"]
+    for name, lvl in levels.items():
+        rids = set(lvl.pop("rids"))
+        seg = lvl["segments_us"]
+        queued = _span_stats(spans, rids, "queued")
+        running = _span_stats(spans, rids, "running")
+        lvl["per_request_us"] = {"queued": queued, "running": running}
+        # The four-way attribution the SLO table cites: queue time is the
+        # requests' own wait, the rest is round-loop wall split by the
+        # profiler (compute = dispatch+harvest, spill = poll+pacing).
+        lvl["attribution_us"] = {
+            "queue": queued["total_us"] if queued else 0,
+            "compute": seg.get("dispatch", 0) + seg.get("harvest", 0),
+            "spill": seg.get("poll", 0) + seg.get("spill", 0),
+            "journal": seg.get("journal", 0),
+            "admit": seg.get("admit", 0),
+        }
+    n_events = write_chrome_trace(
+        trace_path, {},
+        metadata={"bench": "serve-slo", "manifest": manifest_path},
+        extra_events=(serve_trace_events(records)
+                      + journal_trace_events(
+                          read_journal_records(os.path.join(scratch,
+                                                            "journal")))),
+    )
+
+    return {
+        "bench": "serve-slo",
+        "n": args.n,
+        "lanes": args.lanes,
+        "chunk": args.chunk,
+        "warp": not args.no_warp,
+        "warmup_s": round(warmup_s, 3),
+        "compiles_steady": compiles,
+        "compiles_steady_gauge": final_metrics["gauges"]
+                                              .get("compiles_steady", {}),
+        "capacity_rps": round(capacity_rps, 2),
+        "calibration_latency": _latency_stats(cal_lat),
+        "levels": levels,
+        "round_profile": {
+            seg: snap
+            for seg, snap in (
+                (k.split("=", 1)[1], v) for k, v in final_metrics[
+                    "histograms"].get("serve_round_segment_us", {}).items()
+            )
+        },
+        "manifest": manifest_path,
+        "trace": trace_path,
+        "trace_events": n_events,
     }
 
 
@@ -344,6 +603,14 @@ def main(argv=None) -> int:
     parser.add_argument("--overload", action="store_true",
                         help="admission-control study: calibrate capacity, "
                              "then offer 2x/5x/10x against a bounded queue")
+    parser.add_argument("--slo", action="store_true",
+                        help="SLO-attribution study on an obs-enabled "
+                             "server: per-level latency percentiles + "
+                             "queue/compute/spill/journal attribution, "
+                             "plus a Perfetto trace artifact")
+    parser.add_argument("--slo-levels", default="0.5,0.9,1.3",
+                        help="comma-separated load multiples of calibrated "
+                             "capacity for --slo")
     parser.add_argument("--max-queue", type=int, default=None,
                         help="admission queue bound for --overload "
                              "(default 2*lanes)")
@@ -353,9 +620,14 @@ def main(argv=None) -> int:
         args.max_queue = 2 * args.lanes
     if args.out is None:
         args.out = ("BENCH_serve_overload.json" if args.overload
+                    else "BENCH_serve_slo.json" if args.slo
                     else "BENCH_serve.json")
+    args.slo_levels = [float(tok) for tok in args.slo_levels.split(",")]
 
-    report = asyncio.run(_run_overload(args) if args.overload else _run(args))
+    report = asyncio.run(
+        _run_overload(args) if args.overload
+        else _run_slo(args) if args.slo
+        else _run(args))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")

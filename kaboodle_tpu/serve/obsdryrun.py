@@ -1,0 +1,254 @@
+"""The servescope CI lane: traced lifecycle, metrics plane, overhead A/B.
+
+``make serve-obs-dryrun`` (= ``python -m kaboodle_tpu serve --obs-dryrun``)
+boots the full observability stack — obs-enabled engine, server with
+manifest + Prometheus endpoint — and asserts the plane's contracts:
+
+1. **zero fresh compiles with the plane attached**: the whole traced
+   lifecycle (admit, leap and chunk rounds, park, spill, restore, resume,
+   cancel) runs under the KB405 compile counter AND the plane's own
+   ``compiles_steady`` gauge, both pinned to 0 — observability must not
+   perturb the zero-recompile serving contract;
+2. **exposition works end to end**: the ``metrics`` RPC returns the
+   registry snapshot, the HTTP endpoint serves Prometheus text with the
+   expected families, and the streamed manifest passes the schema gate,
+   the ``--serve-report`` waterfall and the Perfetto export (with the
+   journal track) — every consumer surface, exercised;
+3. **observer purity + <= 5 % overhead**: an obs-on engine and an obs-off
+   engine driven through the identical scripted workload end bit-exact
+   (host vectors and device member state leaf-for-leaf), and the obs-on
+   median round time stays within 5 % of obs-off (same bar tickscope set
+   for the on-device counter plane).
+
+Prints a one-line JSON tail for the CI log.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+_WAIT_S = 30.0
+
+
+async def _traced_lifecycle(report: dict, tmp: str) -> str:
+    """Phase 1+2: full lifecycle over an obs server; returns the manifest
+    path for the exporter phase."""
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.engine import ServeEngine
+    from kaboodle_tpu.serve.pool import LanePool
+    from kaboodle_tpu.serve.server import ServeServer
+
+    assert_counter_live()
+    manifest_path = os.path.join(tmp, "obs.manifest.jsonl")
+    engine = ServeEngine(
+        [LanePool(16, 4, chunk=8)], warp=True, max_leap=64,
+        spill_after=2, spill_dir=tmp,
+        journal_dir=os.path.join(tmp, "journal"),
+        obs=True,
+    )
+    server = ServeServer(engine, port=0, manifest_path=manifest_path,
+                         metrics_port=0)
+    engine.warmup()
+    await server.start()
+    client = await ServeClient.connect(port=server.port)
+
+    with compile_counter() as box:
+        rids = []
+        for i in range(8):
+            horizon = bool(i % 2)
+            rids.append(await client.submit(
+                16, seed=i,
+                mode="ticks" if horizon else "converge",
+                ticks=40,
+                scenario="steady" if horizon else "boot",
+                keep=(i == 0),
+            ))
+        for rid in rids:
+            await asyncio.wait_for(client.wait(rid), _WAIT_S)
+
+        kept = rids[0]
+
+        async def _await_state(rid: int, state: str) -> dict:
+            while True:
+                row = await client.status(rid)
+                if row["state"] == state:
+                    return row
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(_await_state(kept, "spilled"), _WAIT_S)
+        assert await client.restore(kept)
+        await client.resume(kept, mode="ticks", ticks=8)
+        await asyncio.wait_for(client.wait(kept), _WAIT_S)
+        await client.cancel(kept)
+
+        # -- the metrics RPC, under the counter: a scrape costs no compile.
+        metrics = await client.metrics()
+    report["compiles_lifecycle"] = box.count
+    gauge = metrics["gauges"]["compiles_steady"][""]
+    report["compiles_steady_gauge"] = gauge
+    assert gauge == 0, metrics["gauges"]
+    assert box.count == 0, box.count
+    counters = metrics["counters"]["serve_events_total"]
+    for needed in ("event=admitted", "event=spilled", "event=restored",
+                   "event=resumed", "event=cancelled"):
+        assert needed in counters, (needed, sorted(counters))
+    segs = metrics["histograms"]["serve_round_segment_us"]
+    assert segs["segment=round"]["count"] > 0, segs
+    report["rounds_profiled"] = segs["segment=round"]["count"]
+
+    # -- Prometheus endpoint: one real HTTP scrape.
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", server.metrics_port)
+    writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), _WAIT_S)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert b"200 OK" in head, head
+    text = body.decode()
+    for family in ("# TYPE serve_events_total counter",
+                   "# TYPE compiles_steady gauge",
+                   "# TYPE serve_round_segment_us summary"):
+        assert family in text, (family, text.splitlines()[:5])
+    report["prometheus_lines"] = len(text.splitlines())
+
+    await client.shutdown()
+    await server.close()
+    return manifest_path
+
+
+def _script_engine(obs):
+    """One engine + the scripted workload both A/B sides run verbatim.
+
+    Dense (no-warp) horizon runs over a 16-tick chunk: every measured
+    round is a real serve-step dispatch, so the overhead ratio compares
+    the plane's cost against the work a busy round actually does — idle
+    rounds are microseconds of bookkeeping where a fixed ~tens-of-us
+    tracing cost would swamp the ratio while being irrelevant to service
+    latency."""
+    from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+    from kaboodle_tpu.serve.pool import LanePool
+
+    engine = ServeEngine([LanePool(16, 4, chunk=16)], warp=False, obs=obs)
+    engine.warmup()
+    for i in range(12):
+        engine.submit(ServeRequest(
+            n=16, seed=i, mode="ticks", ticks=128, scenario="steady",
+        ))
+    return engine
+
+
+def _ab_purity_and_overhead(report: dict) -> None:
+    """Phase 3: identical workloads, obs on vs off — bit-exact state,
+    median busy-round overhead <= 5 %."""
+    import jax
+    import numpy as np
+
+    def run(obs):
+        engine = _script_engine(obs)
+        times = []
+        while engine.busy:  # busy rounds only: real dispatch per sample
+            t0 = time.perf_counter_ns()
+            engine.step()
+            times.append(time.perf_counter_ns() - t0)
+        pool = engine.pools[16]
+        host = {
+            name: np.array(getattr(pool, name))
+            for name in ("occupied", "active", "until_conv", "remaining",
+                         "ticks_run", "conv_tick", "generation")
+        }
+        members = [pool.member(lane) for lane in range(pool.lanes)]
+        table = {
+            rid: {k: row[k] for k in ("state", "result", "pool", "lane")}
+            for rid, row in engine._requests.items()
+        }
+        engine.close()
+        return times, host, members, table
+
+    # A transient host-load spike during either arm inflates the apparent
+    # overhead but can never deflate it below the true cost, so the bound
+    # is gated on the best of up to 3 paired attempts — any attempt within
+    # the bar proves the plane's cost is within the bar. Bit-exactness is
+    # deterministic; one check suffices.
+    overheads: list[float] = []
+    for attempt in range(3):
+        times_off, host_off, members_off, table_off = run(obs=False)
+        times_on, host_on, members_on, table_on = run(obs=True)
+
+        if attempt == 0:
+            assert table_on == table_off, (
+                "request tables diverged under tracing")
+            for name in host_off:
+                assert np.array_equal(host_off[name], host_on[name]), (
+                    f"pool.{name} diverged under tracing")
+            for lane, (a, b) in enumerate(zip(members_off, members_on)):
+                la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+                assert len(la) == len(lb)
+                for x, y in zip(la, lb):
+                    x, y = np.asarray(x), np.asarray(y)
+                    eq = np.issubdtype(x.dtype, np.floating)
+                    assert np.array_equal(x, y, equal_nan=eq), (
+                        f"lane {lane} member state diverged under tracing")
+            report["bitexact_ab"] = True
+
+        assert len(times_off) == len(times_on), (
+            len(times_off), len(times_on))
+        report["ab_rounds"] = len(times_off)
+        med_off = sorted(times_off)[len(times_off) // 2]
+        med_on = sorted(times_on)[len(times_on) // 2]
+        overheads.append(med_on / med_off - 1.0)
+        if overheads[-1] <= 0.05:
+            report["round_median_off_us"] = med_off // 1000
+            report["round_median_on_us"] = med_on // 1000
+            break
+    overhead = min(overheads)
+    report["obs_overhead_pct"] = round(overhead * 100, 2)
+    report["ab_attempts"] = len(overheads)
+    assert overhead <= 0.05, (
+        f"observability overhead {overhead:.1%} > 5% on every attempt "
+        f"({[round(o * 100, 1) for o in overheads]}%)")
+
+
+def _exporters(report: dict, manifest_path: str, tmp: str) -> None:
+    """Phase 2 (continued): every downstream consumer of the manifest."""
+    from kaboodle_tpu.telemetry.summary import main as telemetry_main
+
+    trace_path = os.path.join(tmp, "obs.trace.json")
+    assert telemetry_main([manifest_path, "--check"]) == 0
+    assert telemetry_main([manifest_path, "--serve-report"]) == 0
+    assert telemetry_main([
+        manifest_path, "--serve-report",
+        "--trace", trace_path, "--phase-program", "off",
+        "--journal", os.path.join(tmp, "journal"),
+    ]) == 0
+    with open(trace_path) as f:
+        doc = json.load(f)
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert any(n.startswith("leap x") for n in names), "no leap slices"
+    assert any(n.startswith("r") and ":" in n for n in names), \
+        "no request spans"
+    assert any(n.startswith("spill") for n in names), "no spill events"
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["name"] == "process_name"}
+    assert "serve journal (WAL)" in procs, procs
+    report["trace_events"] = len(doc["traceEvents"])
+
+
+def run_obs_dryrun() -> int:
+    report: dict = {"dryrun": "serve-obs"}
+    tmp = tempfile.mkdtemp(prefix="kaboodle-obs-dryrun-")
+    os.makedirs(os.path.join(tmp, "journal"), exist_ok=True)
+    manifest_path = asyncio.run(_traced_lifecycle(report, tmp))
+    _exporters(report, manifest_path, tmp)
+    _ab_purity_and_overhead(report)
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0

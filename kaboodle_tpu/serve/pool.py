@@ -230,6 +230,11 @@ class LanePool:
         free = np.flatnonzero(~self.occupied)
         return int(free[0]) if free.size else None
 
+    def occupancy(self) -> tuple[int, int, int]:
+        """(occupied, active, lanes) — the cheap per-pool numbers the
+        metrics plane polls every scrape, without building stats()."""
+        return int(self.occupied.sum()), int(self.active.sum()), self.lanes
+
     def admit(
         self,
         lane: int,

@@ -115,11 +115,15 @@ class ServeServer:
         port: int = 0,
         manifest_path: str | None = None,
         stream_queue: int = 256,
+        metrics_port: int | None = None,
     ) -> None:
         self.engine = engine
         self.host = host
         self.port = port
         self.stream_queue = int(stream_queue)
+        # Prometheus-style text endpoint (requires engine.obs). None = off;
+        # 0 = ephemeral port, read back after start().
+        self.metrics_port = metrics_port
         self.manifest = (
             ManifestWriter(manifest_path, stream=True) if manifest_path else None
         )
@@ -129,6 +133,7 @@ class ServeServer:
         self._wake = asyncio.Event()
         self._closed = asyncio.Event()
         self._server: asyncio.base_events.Server | None = None
+        self._metrics_server: asyncio.base_events.Server | None = None
         self._loop_task: asyncio.Task | None = None
 
     # -- event fan-out -----------------------------------------------------
@@ -154,6 +159,15 @@ class ServeServer:
             self._handle, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.metrics_port is not None:
+            if self.engine.obs is None:
+                raise ValueError("metrics_port needs an engine with obs")
+            self._metrics_server = await asyncio.start_server(
+                self._handle_metrics_http, self.host, self.metrics_port
+            )
+            self.metrics_port = (
+                self._metrics_server.sockets[0].getsockname()[1]
+            )
         self._loop_task = asyncio.create_task(self._engine_loop())
 
     async def serve_forever(self) -> None:
@@ -165,6 +179,9 @@ class ServeServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            await self._metrics_server.wait_closed()
         if self._loop_task is not None:
             await self._loop_task
         for sub in self._subscribers:
@@ -205,6 +222,25 @@ class ServeServer:
             ):
                 self.engine.step()
                 self._resolve_waiters()
+
+    async def _handle_metrics_http(self, reader, writer) -> None:
+        """One-shot Prometheus text scrape: any GET path gets the full
+        exposition (stdlib-only HTTP/1.0 — a scraper, not a web server)."""
+        try:
+            while (await reader.readline()).strip():
+                pass  # drain request line + headers; path is irrelevant
+            body = self.engine.obs.metrics.to_prometheus().encode()
+            writer.write(
+                b"HTTP/1.0 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"\r\n" + body
+            )
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
 
     # -- connections -------------------------------------------------------
 
@@ -264,6 +300,12 @@ class ServeServer:
             return {"ok": True}
         if name == "stats":
             return {"ok": True, "stats": self.engine.stats()}
+        if name == "metrics":
+            if self.engine.obs is None:
+                return {"ok": False, "kind": "bad_request",
+                        "error": "engine has no observability plane "
+                                 "(start the server with --obs)"}
+            return {"ok": True, "metrics": self.engine.obs.metrics.collect()}
         if name == "stream":
             await self._stream(writer)
             return None
@@ -336,11 +378,21 @@ def main(argv=None) -> int:
     parser.add_argument("--max-queue", type=int, default=None,
                         help="bound the submit queue (enables admission "
                              "control: priorities, shedding, retry-after)")
+    parser.add_argument("--obs", action="store_true",
+                        help="attach the observability plane: request "
+                             "tracing, round profiler, metrics registry")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve Prometheus text metrics on this port "
+                             "(0 = ephemeral; implies --obs)")
     parser.add_argument("--dryrun", action="store_true",
                         help="run the in-process CI exercise and exit")
     parser.add_argument("--chaos-dryrun", action="store_true",
                         help="run the seeded fault-injection scenarios and "
                              "exit")
+    parser.add_argument("--obs-dryrun", action="store_true",
+                        help="run the observability-plane CI exercise "
+                             "(traced lifecycle, metrics, report, trace "
+                             "export, overhead A/B) and exit")
     args = parser.parse_args(argv)
 
     if args.dryrun:
@@ -351,6 +403,10 @@ def main(argv=None) -> int:
         from kaboodle_tpu.serve.chaos import run_chaos_dryrun
 
         return run_chaos_dryrun()
+    if args.obs_dryrun:
+        from kaboodle_tpu.serve.obsdryrun import run_obs_dryrun
+
+        return run_obs_dryrun()
 
     from kaboodle_tpu.serve.pool import LanePool, lane_n_class
 
@@ -373,6 +429,7 @@ def main(argv=None) -> int:
         spill_after=args.spill_after, spill_dir=args.spill_dir,
         sync_spill=args.sync_spill, journal_dir=args.journal_dir,
         admission=admission,
+        obs=args.obs or args.metrics_port is not None,
     )
     if args.recover:
         if args.journal_dir is None:
@@ -384,12 +441,16 @@ def main(argv=None) -> int:
         server = ServeServer(
             engine, host=args.host, port=args.port,
             manifest_path=args.manifest,
+            metrics_port=args.metrics_port,
         )
         print("warming up...", flush=True)
         engine.warmup()
         await server.start()
         print(f"serving on {server.host}:{server.port} "
               f"(classes {sorted(engine.pools)})", flush=True)
+        if server.metrics_port is not None:
+            print(f"metrics on http://{server.host}:{server.metrics_port}/"
+                  f"metrics", flush=True)
         try:
             await server.serve_forever()
         finally:

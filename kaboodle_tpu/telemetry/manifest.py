@@ -70,6 +70,16 @@ def validate_record(rec) -> dict:
             raise ValueError("'serve_event' records need an integer 'lane'")
     if kind == "serve_round" and not isinstance(rec.get("round"), int):
         raise ValueError("'serve_round' records need an integer 'round'")
+    if kind == "serve_span":
+        if not isinstance(rec.get("span"), str) or not rec.get("span"):
+            raise ValueError(
+                "'serve_span' records need a non-empty string 'span'"
+            )
+        for field in ("t0_us", "dur_us", "request_id"):
+            if not isinstance(rec.get(field), int):
+                raise ValueError(
+                    f"'serve_span' records need an integer {field!r}"
+                )
     return rec
 
 

@@ -10,6 +10,13 @@ additionally exports the tick records as a Chrome-trace/Perfetto JSON.
     python -m kaboodle_tpu telemetry run.jsonl
     python -m kaboodle_tpu telemetry run.jsonl --trace run.trace.json
     python -m kaboodle_tpu telemetry run.jsonl --check   # schema gate (CI)
+    python -m kaboodle_tpu telemetry serve.jsonl --serve-report
+
+Serve manifests (PR 14 ``serve_span`` records) get two extras: ``--trace``
+renders per-lane request/leap/spill tracks on the wall-clock timeline
+(``--journal DIR`` adds the WAL appends as a sibling track), and
+``--serve-report`` prints a per-request waterfall plus a per-phase SLO
+table (queue vs compute vs spill attribution).
 """
 
 from __future__ import annotations
@@ -38,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--check", action="store_true",
                    help="schema gate: exit nonzero unless every record "
                         "validates and at least one record exists")
+    p.add_argument("--serve-report", action="store_true",
+                   help="per-request waterfall + per-phase SLO table from "
+                        "serve_span records")
+    p.add_argument("--journal", metavar="DIR", default=None,
+                   help="with --trace: add the serve WAL in DIR as a "
+                        "journal-appends track (seq-ordered)")
     return p
 
 
@@ -57,6 +70,111 @@ def _phase_program(mode: str):
         SwimConfig(deterministic=True), faulty=(mode != "span"), telemetry=True
     )
     return plan(graph, mode)
+
+
+def _pct(sorted_us: list[int], q: float) -> int:
+    """Exact sample quantile over a sorted list (host-side, small N)."""
+    if not sorted_us:
+        return 0
+    return sorted_us[min(int(q * len(sorted_us)), len(sorted_us) - 1)]
+
+
+def serve_report(records: list[dict]) -> dict:
+    """Fold ``serve_span`` records into the waterfall + SLO structures.
+
+    Returns ``{"requests": {rid: {"phases": [...], "total_us", "fate"}},
+    "phases": {span: {count, total_us, p50/p90/p99/max_us}}, "e2e":
+    {...}}`` — per-request phase sequences ordered by ``t0_us``, and the
+    per-phase latency attribution the SLO table prints. End-to-end
+    latency is first ``t0_us`` to last span end, so queue time counts."""
+    by_rid: dict[int, list[dict]] = {}
+    for rec in records:
+        if rec.get("kind") != "serve_span":
+            continue
+        rid = int(rec["request_id"])
+        if rid < 0:
+            continue  # round / advance spans: engine-level, not a request
+        by_rid.setdefault(rid, []).append(rec)
+    requests: dict[int, dict] = {}
+    phase_us: dict[str, list[int]] = {}
+    e2e: list[int] = []
+    for rid in sorted(by_rid):
+        spans = sorted(by_rid[rid], key=lambda r: int(r["t0_us"]))
+        phases = []
+        fate = None
+        for s in spans:
+            phases.append({
+                "span": s["span"], "t0_us": int(s["t0_us"]),
+                "dur_us": int(s["dur_us"]), "pool_n": s.get("pool_n", -1),
+                "lane": s.get("lane", -1),
+            })
+            if s.get("fate"):
+                fate = s["fate"]
+            if s.get("open"):
+                fate = fate or "open"
+            phase_us.setdefault(s["span"], []).append(int(s["dur_us"]))
+        total = (int(spans[-1]["t0_us"]) + int(spans[-1]["dur_us"])
+                 - int(spans[0]["t0_us"]))
+        requests[rid] = {"phases": phases, "total_us": total,
+                         "fate": fate or "done"}
+        e2e.append(total)
+    phases_out = {}
+    for span, durs in sorted(phase_us.items()):
+        durs.sort()
+        phases_out[span] = {
+            "count": len(durs), "total_us": sum(durs),
+            "p50_us": _pct(durs, 0.50), "p90_us": _pct(durs, 0.90),
+            "p99_us": _pct(durs, 0.99), "max_us": durs[-1],
+        }
+    e2e.sort()
+    return {
+        "requests": requests,
+        "phases": phases_out,
+        "e2e": {
+            "count": len(e2e), "p50_us": _pct(e2e, 0.50),
+            "p90_us": _pct(e2e, 0.90), "p99_us": _pct(e2e, 0.99),
+            "max_us": e2e[-1] if e2e else 0,
+        },
+    }
+
+
+_PHASE_GLYPH = {"queued": "q", "running": "R", "parked": "p",
+                "spilling": "s", "spilled": "S"}
+
+
+def print_serve_report(report: dict, width: int = 48, max_rows: int = 30
+                       ) -> None:
+    """Render the waterfall (one scaled bar per request) + SLO table."""
+    reqs = report["requests"]
+    if not reqs:
+        print("  serve-report: no request spans")
+        return
+    t_lo = min(p["t0_us"] for r in reqs.values() for p in r["phases"])
+    t_hi = max(p["t0_us"] + p["dur_us"]
+               for r in reqs.values() for p in r["phases"])
+    scale = max(t_hi - t_lo, 1)
+    print(f"  serve-report: {len(reqs)} requests, "
+          f"timeline {scale} us")
+    for i, (rid, row) in enumerate(sorted(reqs.items())):
+        if i >= max_rows:
+            print(f"    ... {len(reqs) - max_rows} more requests")
+            break
+        bar = [" "] * width
+        for p in row["phases"]:
+            a = (p["t0_us"] - t_lo) * width // scale
+            b = (p["t0_us"] + p["dur_us"] - t_lo) * width // scale
+            g = _PHASE_GLYPH.get(p["span"], "?")
+            for j in range(min(a, width - 1), min(max(b, a + 1), width)):
+                bar[j] = g
+        seq = ">".join(p["span"] for p in row["phases"])
+        print(f"    r{rid:<4} |{''.join(bar)}| "
+              f"{row['total_us']:>8} us  {row['fate']:<10} {seq}")
+    print("    phase       count   p50_us   p90_us   p99_us   max_us")
+    rows = dict(report["phases"])
+    rows["e2e"] = report["e2e"]
+    for span, st in rows.items():
+        print(f"    {span:<10} {st['count']:>6} {st['p50_us']:>8} "
+              f"{st['p90_us']:>8} {st['p99_us']:>8} {st['max_us']:>8}")
 
 
 def load_manifests(paths: list[str]) -> dict[str, list[dict]]:
@@ -191,8 +309,20 @@ def main(argv=None) -> int:
         for eng, agg in sorted(s["round_engines"].items()):
             print(f"    {eng}: {agg['rounds']} rounds, {agg['ticks']} ticks")
 
+    if args.serve_report:
+        all_recs = [r for recs in records.values() for r in recs]
+        report = serve_report(all_recs)
+        print_serve_report(report)
+        summary["serve_report"] = {
+            "requests": len(report["requests"]),
+            "phases": report["phases"],
+            "e2e": report["e2e"],
+        }
+
     if args.trace:
-        from kaboodle_tpu.telemetry.trace import write_chrome_trace
+        from kaboodle_tpu.telemetry.trace import (
+            journal_trace_events, serve_trace_events, write_chrome_trace,
+        )
 
         # One Perfetto process track PER MANIFEST: each manifest is its own
         # run, and pooling runs onto one track would corrupt the leap-gap
@@ -205,10 +335,23 @@ def main(argv=None) -> int:
             None if args.phase_program == "off"
             else _phase_program(args.phase_program)
         )
+        # Serve manifests additionally render the wall-clock service view:
+        # per-lane request/leap tracks per manifest (disjoint pid ranges),
+        # plus the WAL appends when --journal points at the journal dir.
+        extra: list[dict] = []
+        for i, (path, recs) in enumerate(records.items()):
+            if any(r["kind"] == "serve_span" for r in recs):
+                extra.extend(serve_trace_events(recs, pid_base=10 + 20 * i))
+        if args.journal:
+            from kaboodle_tpu.serve.journal import read_journal_records
+
+            extra.extend(journal_trace_events(
+                read_journal_records(args.journal)))
         n = write_chrome_trace(args.trace,
                                {p: rows for p, rows in groups.items() if rows},
                                metadata={"manifests": args.paths},
-                               program=program)
+                               program=program,
+                               extra_events=extra)
         print(f"  trace: {n} events -> {args.trace}")
         summary["trace_events"] = n
 

@@ -147,8 +147,154 @@ def phase_slice_events(program, tick_rows, pid: int = 1) -> list[dict]:
     return events
 
 
+def serve_trace_events(records, pid_base: int = 10) -> list[dict]:
+    """Serve manifest records -> Chrome trace events on the wall timeline.
+
+    Renders the servescope (PR 14) record kinds into one shared-clock
+    view — all timestamps are the engine/journal monotonic microseconds
+    (``t0_us`` / ``t_us``), NOT the simulated-tick axis of
+    :func:`chrome_trace_events`, so the two families should go in separate
+    trace files.
+
+    Layout: one **engine process** (``pid_base``) with the round envelope
+    on thread 1 and the profiler's segment split laid out sequentially
+    under each round on thread 2 (segments are sub-totals, not contiguous
+    wall intervals — the layout shows proportion, the args carry truth);
+    one **process per N-class pool** with a thread per lane: request
+    phase spans (``queued`` / ``running`` / ``parked`` / ``spilling`` /
+    ``spilled``) land on their lane's track (off-lane phases on the
+    pool's "queue/off-lane" thread 1), and each ``advance`` span fans
+    onto the lanes it moved — leap rounds named with the Warp signature
+    class and leap length, chunk rounds with ticks run. ``serve_event``
+    records that carry a ``t_us`` stamp (spill lifecycle, shed,
+    recovery) become instant markers on the same tracks.
+    """
+    records = list(records)
+    pools = sorted({
+        int(r["pool_n"]) for r in records
+        if r.get("kind") in ("serve_span", "serve_event")
+        and int(r.get("pool_n", -1)) >= 0
+    })
+    pool_pid = {n: pid_base + 1 + i for i, n in enumerate(pools)}
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid_base,
+         "args": {"name": "serve engine"}},
+        {"name": "thread_name", "ph": "M", "pid": pid_base, "tid": 1,
+         "args": {"name": "rounds"}},
+        {"name": "thread_name", "ph": "M", "pid": pid_base, "tid": 2,
+         "args": {"name": "round segments"}},
+    ]
+    lanes_seen: set[tuple[int, int]] = set()
+    for n, pid in pool_pid.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"lane pool N={n}"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": "queue/off-lane"}})
+
+    def lane_tid(pid: int, lane: int) -> int:
+        tid = lane + 2
+        if (pid, lane) not in lanes_seen:
+            lanes_seen.add((pid, lane))
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": f"lane {lane}"}})
+        return tid
+
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "serve_span":
+            span = rec["span"]
+            t0, dur = int(rec["t0_us"]), max(int(rec["dur_us"]), 1)
+            if span == "round":
+                args = {"round": rec.get("round")}
+                args.update(rec.get("segments") or {})
+                events.append({
+                    "name": f"round {rec.get('round')}", "ph": "X",
+                    "pid": pid_base, "tid": 1, "ts": t0, "dur": dur,
+                    "args": args,
+                })
+                off = t0
+                for seg, us in (rec.get("segments") or {}).items():
+                    if us <= 0:
+                        continue
+                    events.append({
+                        "name": seg, "ph": "X", "pid": pid_base, "tid": 2,
+                        "ts": off, "dur": int(us), "args": {"us": int(us)},
+                    })
+                    off += int(us)
+            elif span == "advance":
+                pid = pool_pid.get(int(rec.get("pool_n", -1)), pid_base)
+                eng = rec.get("engine", "?")
+                for c in rec.get("classes") or []:
+                    k = int(c.get("k", 0))
+                    if eng == "leap":
+                        name = f"leap x{k} [{c.get('class_key', '?')}]"
+                    else:
+                        name = f"run x{k}"
+                    events.append({
+                        "name": name, "ph": "X", "pid": pid,
+                        "tid": lane_tid(pid, int(c["lane"])),
+                        "ts": t0, "dur": dur,
+                        "args": {**c, "engine": eng,
+                                 "round": rec.get("round")},
+                    })
+            else:
+                rid = int(rec["request_id"])
+                pool_n = int(rec.get("pool_n", -1))
+                lane = int(rec.get("lane", -1))
+                pid = pool_pid.get(pool_n, pid_base)
+                tid = lane_tid(pid, lane) if lane >= 0 else (
+                    1 if pool_n >= 0 else 3)
+                args = {k: v for k, v in rec.items()
+                        if k not in ("schema", "kind", "span", "t0_us",
+                                     "dur_us")}
+                events.append({
+                    "name": f"r{rid}:{span}", "ph": "X", "pid": pid,
+                    "tid": tid, "ts": t0, "dur": dur, "args": args,
+                })
+        elif kind == "serve_event" and isinstance(rec.get("t_us"), int):
+            pool_n = int(rec.get("pool_n", -1))
+            lane = int(rec.get("lane", -1))
+            pid = pool_pid.get(pool_n, pid_base)
+            tid = lane_tid(pid, lane) if lane >= 0 and pid != pid_base else 1
+            args = {k: v for k, v in rec.items()
+                    if k not in ("schema", "kind", "t_us")}
+            events.append({
+                "name": rec.get("event", "?"), "ph": "i", "s": "t",
+                "pid": pid, "tid": tid, "ts": int(rec["t_us"]),
+                "args": args,
+            })
+    return events
+
+
+def journal_trace_events(records, pid: int = 9) -> list[dict]:
+    """WAL records (``journal.read_journal_records``) -> instant markers.
+
+    Post-PR-14 records carry ``ts_us`` on the engine's shared monotonic
+    epoch, so journal writes line up under the serve spans; ``seq`` orders
+    them (crash-recovery order). Pre-seq records have no timestamp and are
+    skipped — there is nowhere honest to put them on a wall timeline.
+    """
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": "serve journal (WAL)"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+         "args": {"name": "appends"}},
+    ]
+    for rec in sorted(records, key=lambda r: int(r.get("seq", -1))):
+        if not isinstance(rec.get("ts_us"), int):
+            continue
+        events.append({
+            "name": f"{rec.get('op', '?')} r{rec.get('rid')}", "ph": "i",
+            "s": "t", "pid": pid, "tid": 1, "ts": int(rec["ts_us"]),
+            "args": {"op": rec.get("op"), "rid": rec.get("rid"),
+                     "seq": rec.get("seq", None)},
+        })
+    return events
+
+
 def write_chrome_trace(
-    path: str, tick_rows, metadata: dict | None = None, program=None
+    path: str, tick_rows, metadata: dict | None = None, program=None,
+    extra_events: list[dict] | None = None,
 ) -> int:
     """Write rows as a Chrome-trace JSON file; returns the event count.
 
@@ -159,7 +305,10 @@ def write_chrome_trace(
     program (or its ``describe()`` dict): each run track then gets a second
     thread of per-pass slices (:func:`phase_slice_events`) showing which
     pass each phase op landed in; the program structure is also embedded in
-    ``otherData.phase_program``."""
+    ``otherData.phase_program``. ``extra_events`` (optional) are appended
+    verbatim — the summarizer uses this for the serve/journal tracks
+    (:func:`serve_trace_events` / :func:`journal_trace_events`), which live
+    on their own pids."""
     if isinstance(tick_rows, dict):
         events = []
         for i, (label, rows) in enumerate(tick_rows.items(), start=1):
@@ -172,6 +321,8 @@ def write_chrome_trace(
         events = chrome_trace_events(tick_rows)
         if program is not None:
             events.extend(phase_slice_events(program, tick_rows))
+    if extra_events:
+        events.extend(extra_events)
     if program is not None:
         desc = program.describe() if hasattr(program, "describe") else program
         metadata = {**(metadata or {}), "phase_program": desc}

@@ -1,0 +1,515 @@
+"""Servescope (ISSUE 14): observability-plane units + engine contracts.
+
+The plane's one hard promise: it OBSERVES. An engine with tracing +
+profiler + metrics attached must end bit-identical to one without, must
+never compile anything (``compiles_steady`` pinned to 0 across the full
+admit/leap/park/spill/restore lifecycle), and every record it emits must
+pass the manifest schema and render through the exporters.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+from kaboodle_tpu.serve.obsplane import (
+    SEG_ADMIT,
+    SEG_JOURNAL,
+    SEG_ROUND,
+    SEGMENTS,
+    Histogram,
+    MetricsRegistry,
+    ObsPlane,
+    RoundProfiler,
+)
+from kaboodle_tpu.serve.pool import LanePool
+
+CFG = SwimConfig(deterministic=True)
+N = 16  # shares test_serve.py's compiled set within the pytest process
+
+
+def _pool(lanes: int = 3, **kw) -> LanePool:
+    return LanePool(N, lanes, cfg=CFG, chunk=4, **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        eq = np.issubdtype(x.dtype, np.floating)
+        if not np.array_equal(x, y, equal_nan=eq):
+            return False
+    return True
+
+
+# -- registry / histogram / profiler units ----------------------------------
+
+
+def test_histogram_buckets_and_quantiles():
+    h = Histogram()
+    for us in (0, 1, 3, 100, 100, 100, 5000):
+        h.observe(us)
+    assert h.count == 7
+    assert h.total_us == 5304
+    assert h.max_us == 5000
+    snap = h.snapshot()
+    # log2 buckets: the p50 sample (100us) reports its bucket's upper
+    # bound, 127 — factor-of-2 resolution is the documented contract.
+    assert snap["p50_us"] == 127
+    assert snap["p99_us"] >= 5000 // 2
+    assert snap["mean_us"] == pytest.approx(5304 / 7, abs=0.1)
+
+
+def test_registry_counters_gauges_prometheus():
+    m = MetricsRegistry()
+    m.inc("reqs_total", event="admitted")
+    m.inc("reqs_total", event="admitted")
+    m.inc("reqs_total", event="shed")
+    m.register_gauge("depth", lambda: 7)
+    m.register_multi_gauge(
+        "tokens", lambda: {(("tenant", "a"),): 3.5, (("tenant", "b"),): 1.0}
+    )
+    h = m.histogram("lat_us", phase="run")
+    h.observe(10)
+    ext = Histogram()
+    ext.observe(99)
+    m.attach_histogram("seg_us", ext, segment="admit")
+
+    snap = m.collect()
+    assert snap["counters"]["reqs_total"]["event=admitted"] == 2
+    assert snap["gauges"]["depth"][""] == 7.0
+    assert snap["gauges"]["tokens"]["tenant=a"] == 3.5
+    assert snap["histograms"]["lat_us"]["phase=run"]["count"] == 1
+    # attach_histogram shares the object: later observes are visible.
+    ext.observe(1)
+    assert snap["histograms"]["seg_us"]["segment=admit"]["count"] == 1
+    assert m.collect()["histograms"]["seg_us"]["segment=admit"]["count"] == 2
+
+    text = m.to_prometheus()
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{event="admitted"} 2' in text
+    assert 'tokens{tenant="a"} 3.5' in text
+    assert '# TYPE lat_us summary' in text
+    assert 'lat_us_count{phase="run"} 1' in text
+
+
+def test_round_profiler_accounting():
+    p = RoundProfiler()
+    p.round_begin()
+    t = p.mark()
+    t = p.lap(SEG_ADMIT, t)
+    p.add_ns(SEG_JOURNAL, 5_000_000)  # 5 ms charged out of band
+    p.round_end()
+    assert p.rounds == 1
+    assert int(p.last_us[SEG_JOURNAL]) == 5000
+    assert int(p.last_us[SEG_ROUND]) >= 0
+    segs = p.last_segments()
+    assert set(segs) == set(SEGMENTS) - {"round"}
+    assert p.hist[SEG_JOURNAL].count == 1
+    assert p.totals_us()["journal"] == 5000
+
+
+# -- span tracing units ------------------------------------------------------
+
+
+def _fake_clock(start=0):
+    box = {"t": start}
+
+    def clock():
+        return box["t"]
+
+    return box, clock
+
+
+def test_transition_opens_and_closes_spans():
+    box, clock = _fake_clock()
+    obs = ObsPlane(trace=True, clock_ns=clock)
+    assert obs.transition(0, "queued", pool_n=16) is None  # nothing open
+    box["t"] += 5_000_000  # +5 ms
+    rec = obs.transition(0, "running", pool_n=16, lane=2)
+    assert rec["kind"] == "serve_span"
+    assert rec["span"] == "queued"
+    assert rec["request_id"] == 0
+    assert rec["t0_us"] == 0 and rec["dur_us"] == 5000
+    box["t"] += 1_000_000
+    rec = obs.transition(0, None, fate="completed", ticks_run=12)
+    assert rec["span"] == "running"
+    assert rec["lane"] == 2
+    assert rec["fate"] == "completed" and rec["ticks_run"] == 12
+    assert obs.transition(0, None) is None  # already terminal
+    assert obs.flush_spans() == []
+
+
+def test_flush_spans_marks_open():
+    box, clock = _fake_clock()
+    obs = ObsPlane(trace=True, clock_ns=clock)
+    obs.transition(3, "spilled", pool_n=16)
+    box["t"] += 2_000_000
+    out = obs.flush_spans()
+    assert len(out) == 1
+    assert out[0]["span"] == "spilled" and out[0]["open"] is True
+    assert out[0]["dur_us"] == 2000
+
+
+def test_trace_off_is_inert():
+    obs = ObsPlane(trace=False)
+    assert obs.transition(0, "queued") is None
+    assert obs.flush_spans() == []
+
+
+def test_on_record_folds_counters():
+    obs = ObsPlane(trace=False)
+    obs.on_record({"kind": "serve_event", "event": "shed",
+                   "tenant": "t1", "priority": 0})
+    obs.on_record({"kind": "serve_event", "event": "rejected",
+                   "tenant": "t2", "reason": "quota"})
+    obs.on_record({"kind": "serve_event", "event": "spill_failed"})
+    obs.on_record({"kind": "serve_round", "engine": "leap", "ticks": 40})
+    c = obs.metrics.collect()["counters"]
+    assert c["serve_shed_total"]["priority=0,tenant=t1"] == 1
+    assert c["serve_rejected_total"]["reason=quota,tenant=t2"] == 1
+    assert c["serve_spill_incidents_total"]["kind=spill_failed"] == 1
+    assert c["serve_rounds_total"]["engine=leap"] == 1
+    assert c["serve_ticks_total"]["engine=leap"] == 40
+
+
+def test_serve_span_schema_validation():
+    from kaboodle_tpu.telemetry.manifest import run_record, validate_record
+
+    good = run_record("serve_span", span="queued", request_id=1,
+                      t0_us=0, dur_us=5, pool_n=16, lane=0)
+    validate_record(good)
+    with pytest.raises(ValueError):
+        validate_record(run_record("serve_span", span="", request_id=1,
+                                   t0_us=0, dur_us=5))
+    with pytest.raises(ValueError):
+        validate_record(run_record("serve_span", span="queued",
+                                   request_id=1, t0_us=0))
+
+
+# -- journal seq/ts satellite ------------------------------------------------
+
+
+def test_journal_seq_and_ts(tmp_path):
+    from kaboodle_tpu.serve.journal import ServeJournal, read_journal_records
+
+    j = ServeJournal(str(tmp_path))
+    j.epoch_ns = 0
+    j.append("submitted", 0, req={"n": 16})
+    j.append("admitted", 0, lane=1)
+    j.close()
+    recs = read_journal_records(str(tmp_path))
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert all(isinstance(r["ts_us"], int) and r["ts_us"] > 0 for r in recs)
+
+    # Restart: the counter resumes past everything on disk.
+    j2 = ServeJournal(str(tmp_path))
+    j2.append("harvested", 0, event="completed")
+    j2.close()
+    recs = read_journal_records(str(tmp_path))
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+
+    table, next_rid = ServeJournal(str(tmp_path)).replay()
+    assert table[0]["seq"] == 2  # last transition's ordering metadata
+    assert next_rid == 1
+
+
+def test_journal_backcompat_pre_seq_records(tmp_path):
+    """Old journals (no seq/ts) replay and export exactly as before."""
+    from kaboodle_tpu.serve.journal import ServeJournal, read_journal_records
+
+    wal = tmp_path / "wal.jsonl"
+    wal.write_text(
+        json.dumps({"op": "submitted", "rid": 4, "req": {"n": 16}}) + "\n"
+        + json.dumps({"op": "admitted", "rid": 4, "lane": 0}) + "\n"
+    )
+    table, next_rid = ServeJournal(str(tmp_path)).replay()
+    assert table[4]["op"] == "admitted"
+    assert "seq" not in table[4] and next_rid == 5
+    recs = read_journal_records(str(tmp_path))
+    assert [r["op"] for r in recs] == ["submitted", "admitted"]  # file order
+    # A post-upgrade journal on the same dir starts seq at 0 and appends
+    # AFTER the old records; mixed files keep old-first order.
+    j = ServeJournal(str(tmp_path))
+    j.append("harvested", 4, event="completed")
+    j.close()
+    recs = read_journal_records(str(tmp_path))
+    assert [r["op"] for r in recs][-1] == "harvested"
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def _span(rid, span, t0, dur, pool_n=N, lane=-1, **kw):
+    from kaboodle_tpu.telemetry.manifest import run_record
+
+    return run_record("serve_span", span=span, request_id=rid, t0_us=t0,
+                      dur_us=dur, pool_n=pool_n, lane=lane, **kw)
+
+
+def test_serve_trace_events_layout():
+    from kaboodle_tpu.telemetry.trace import serve_trace_events
+
+    records = [
+        _span(0, "queued", 0, 100),
+        _span(0, "running", 100, 900, lane=1),
+        _span(-1, "round", 0, 1000, pool_n=-1, round=0,
+              segments={"admit": 40, "dispatch": 800}),
+        _span(-1, "advance", 120, 500, round=0, engine="leap", bucket=32,
+              classes=[{"lane": 1, "k": 32, "mode": "leap",
+                        "class_key": 0, "terms": []}]),
+    ]
+    events = serve_trace_events(records, pid_base=10)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["r0:queued"]["tid"] == 1  # off-lane -> queue track
+    assert by_name["r0:running"]["tid"] == 3  # lane 1 -> tid lane+2
+    assert by_name["r0:running"]["pid"] == 11  # first pool pid
+    assert by_name["round 0"]["pid"] == 10
+    assert by_name["leap x32 [0]"]["tid"] == 3  # fanned onto lane 1
+    # segment sub-slices laid out from round t0 in order
+    assert by_name["admit"]["ts"] == 0 and by_name["dispatch"]["ts"] == 40
+
+
+def test_journal_trace_events_order_and_skip():
+    from kaboodle_tpu.telemetry.trace import journal_trace_events
+
+    events = journal_trace_events([
+        {"op": "admitted", "rid": 0, "seq": 1, "ts_us": 20},
+        {"op": "submitted", "rid": 0, "seq": 0, "ts_us": 10},
+        {"op": "legacy", "rid": 9},  # pre-seq: no timestamp, skipped
+    ])
+    inst = [e for e in events if e["ph"] == "i"]
+    assert [e["ts"] for e in inst] == [10, 20]  # seq order
+    assert len(inst) == 2
+
+
+def test_serve_report_waterfall():
+    from kaboodle_tpu.telemetry.summary import serve_report
+
+    report = serve_report([
+        _span(0, "queued", 0, 100),
+        _span(0, "running", 100, 900, lane=0, fate="completed",
+              ticks_run=40),
+        _span(1, "queued", 50, 500),
+        _span(1, "running", 550, 200, lane=1, fate="shed"),
+    ])
+    assert report["requests"][0]["total_us"] == 1000
+    assert report["requests"][0]["fate"] == "completed"
+    assert report["requests"][1]["fate"] == "shed"
+    assert report["phases"]["queued"]["count"] == 2
+    assert report["phases"]["queued"]["total_us"] == 600
+    assert report["e2e"]["count"] == 2
+    assert report["e2e"]["max_us"] == 1000
+
+
+# -- engine contracts --------------------------------------------------------
+
+
+def test_compiles_steady_zero_across_lifecycle(tmp_path):
+    """The metrics-plane pin: compiles_steady reads 0 over the FULL traced
+    lifecycle — admit, leap (warp), chunk, park, spill, restore, resume —
+    and the plane's gauge agrees with an outer KB405 counter."""
+    from kaboodle_tpu.analysis.ir.surface import compile_counter
+
+    recs: list[dict] = []
+    engine = ServeEngine(
+        [_pool(lanes=3)], warp=True, max_leap=16,
+        spill_after=1, spill_dir=str(tmp_path), obs=True,
+    )
+    engine.on_event = recs.append
+    engine.warmup()
+    with compile_counter() as box:
+        kept = engine.submit(ServeRequest(n=N, seed=1, mode="ticks",
+                                          ticks=40, scenario="steady",
+                                          keep=True))
+        conv = engine.submit(ServeRequest(n=N, seed=2, mode="converge",
+                                          ticks=40))
+        for _ in range(120):
+            engine.step()
+            engine.settle_spills()  # join the async writer, fold results
+            if engine.status(kept)["state"] == "spilled":
+                break
+        assert engine.status(kept)["state"] == "spilled"
+        while engine.busy:
+            engine.step()
+        assert engine.status(conv)["state"] == "done"
+        assert engine.restore(kept)
+        engine.resume(kept, mode="ticks", ticks=4)
+        while engine.busy:
+            engine.step()
+        gauges = engine.obs.metrics.collect()["gauges"]
+    assert box.count == 0
+    assert gauges["compiles_steady"][""] == 0.0
+    spans = {r["span"] for r in recs if r["kind"] == "serve_span"}
+    assert {"queued", "running", "parked", "spilling", "round",
+            "advance"} <= spans
+    leap = [r for r in recs if r.get("span") == "advance"
+            and r.get("engine") == "leap"]
+    assert leap and all("class_key" in c for r in leap
+                        for c in r["classes"])
+    engine.close()
+
+
+def test_tracing_on_off_bit_identical():
+    """Observer purity at the engine level: same scripted workload, obs
+    on vs off, member state and host vectors end equal leaf-for-leaf."""
+    def run(obs):
+        engine = ServeEngine([_pool(lanes=2)], warp=True, max_leap=16,
+                             obs=obs)
+        engine.warmup()
+        for i in range(4):
+            engine.submit(ServeRequest(
+                n=N, seed=i, mode="ticks" if i % 2 else "converge",
+                ticks=16, scenario="steady" if i % 2 else "boot"))
+        while engine.busy:
+            engine.step()
+        pool = engine.pools[N]
+        host = {f: np.array(getattr(pool, f))
+                for f in ("occupied", "active", "ticks_run", "conv_tick",
+                          "remaining", "generation")}
+        members = [pool.member(e) for e in range(pool.lanes)]
+        results = {rid: row["result"]
+                   for rid, row in engine._requests.items()}
+        engine.close()
+        return host, members, results
+
+    host_a, mem_a, res_a = run(obs=False)
+    host_b, mem_b, res_b = run(obs=True)
+    assert res_a == res_b
+    for f in host_a:
+        assert np.array_equal(host_a[f], host_b[f]), f
+    for a, b in zip(mem_a, mem_b):
+        assert _leaves_equal(a, b)
+
+
+def test_engine_binds_gauges_and_segments(tmp_path):
+    """bind() wires live pull-gauges over engine state: queue depth, lane
+    occupancy, journal lag, and the profiler's segment histograms."""
+    engine = ServeEngine([_pool(lanes=2)], warp=False,
+                         journal_dir=str(tmp_path / "j"), obs=True)
+    engine.warmup()
+    for seed in range(3):
+        engine.submit(ServeRequest(n=N, seed=seed, mode="ticks", ticks=16,
+                                   scenario="steady"))
+    engine.step()
+    snap = engine.obs.metrics.collect()
+    g = snap["gauges"]
+    assert g["serve_queue_depth"][""] == 1.0  # 2 lanes running, 1 queued
+    assert g["serve_lanes_occupied"][f"pool={N}"] == 2.0
+    assert g["serve_requests"]["state=running"] == 2.0
+    assert g["serve_journal_lag_appends"][""] > 0
+    segs = snap["histograms"]["serve_round_segment_us"]
+    assert segs["segment=round"]["count"] == 1
+    assert engine.obs.metrics.to_prometheus().startswith("# TYPE")
+    while engine.busy:
+        engine.step()
+    engine.close()
+    # close() is idempotent and detaches the compile listener.
+    engine.obs.close()
+
+
+def test_recover_emits_spans_in_seq_order(tmp_path):
+    """Crash recovery replays the journal and re-opens spans for requeued
+    and spilled requests, ordered by journal seq."""
+    jdir = str(tmp_path / "j")
+    engine = ServeEngine([_pool(lanes=2)], warp=False, journal_dir=jdir)
+    engine.warmup()
+    rids = [engine.submit(ServeRequest(n=N, seed=i, mode="ticks",
+                                       ticks=32, scenario="steady"))
+            for i in range(3)]
+    engine.step()  # admit + first chunk; then "crash" (no close)
+    engine._spiller and engine._spiller.close()
+
+    recs: list[dict] = []
+    fresh = ServeEngine([_pool(lanes=2)], warp=False, journal_dir=jdir,
+                        obs=True)
+    fresh.on_event = recs.append
+    fresh.warmup()
+    counts = fresh.recover()
+    assert counts["requeued"] == len(rids)
+    # recover opens queued spans; they close through admit/harvest below.
+    while fresh.busy:
+        fresh.step()
+    assert all(fresh.status(r)["state"] == "done" for r in rids)
+    fresh.close()
+    flushed = [r for r in recs if r.get("kind") == "serve_span"]
+    assert {r["request_id"] for r in flushed if r["span"] == "running"} \
+        == set(rids)
+
+
+def test_pool_occupancy_matches_stats():
+    pool = _pool(lanes=3)
+    pool.warmup()
+    pool.admit(0, seed=0, until_conv=False, budget=8, scenario="steady")
+    pool.admit(2, seed=1, until_conv=False, budget=8, scenario="steady")
+    pool.park(2)
+    occupied, active, lanes = pool.occupancy()
+    assert (occupied, active, lanes) == (2, 1, 3)
+
+
+def test_admission_snapshot():
+    from kaboodle_tpu.serve.admission import AdmissionController
+
+    ctl = AdmissionController(max_queue=8,
+                              quotas={"t0": (10.0, 4.0)},
+                              default_quota=(1.0, 2.0))
+    ctl.check_quota("t0")
+    ctl.check_quota("anon")
+    snap = ctl.snapshot()
+    assert snap["max_queue"] == 8
+    assert snap["tenants"]["t0"]["rate"] == 10.0
+    assert snap["tenants"]["t0"]["burst"] == 4.0
+    assert snap["tenants"]["t0"]["tokens"] <= 4.0
+    assert snap["tenants"]["anon"]["tokens"] <= 2.0
+
+
+# -- loadgen satellite -------------------------------------------------------
+
+
+def test_overload_breakdown_schema():
+    """The --overload report's per-tenant / per-priority shed breakdown:
+    run one tiny overload phase against a real bounded-queue server and
+    check the buckets partition the aggregate counts."""
+    import asyncio
+
+    from kaboodle_tpu.serve.admission import AdmissionController
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.loadgen import _overload_phase
+    from kaboodle_tpu.serve.server import ServeServer
+
+    async def drive():
+        engine = ServeEngine([_pool(lanes=2)], warp=True, max_leap=16,
+                             admission=AdmissionController(max_queue=2))
+        server = ServeServer(engine, port=0)
+        engine.warmup()
+        await server.start()
+
+        async def client_factory():
+            return await ServeClient.connect(port=server.port)
+
+        phase = await _overload_phase(client_factory, server.port, N,
+                                      rate=500.0, requests=12)
+        probe = await client_factory()
+        await probe.shutdown()
+        await server.close()
+        return phase
+
+    phase = asyncio.run(drive())
+    assert set(phase["by_tenant"]) == {"t0", "t1", "t2"}
+    assert set(phase["by_priority"]) == {"0", "1", "2"}
+    for dim in ("by_tenant", "by_priority"):
+        assert sum(b["offered"] for b in phase[dim].values()) == 12
+        assert sum(b["rejected"] for b in phase[dim].values()) \
+            == phase["rejected"]
+        assert sum(b["shed"] for b in phase[dim].values()) == phase["shed"]
+        assert sum(b["completed"] for b in phase[dim].values()) \
+            == phase["completed"]
+        for b in phase[dim].values():
+            assert 0.0 <= b["shed_rate"] <= 1.0

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -66,6 +66,7 @@ ci: lint native test
 	$(MAKE) serve-dryrun
 	$(MAKE) serve-chaos-dryrun
 	$(MAKE) serve-obs-dryrun
+	$(MAKE) costscope-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -160,6 +161,23 @@ serve-chaos-dryrun:
 # `python -m kaboodle_tpu serve-load --slo` (PERF.md, BENCH_serve_slo.json).
 serve-obs-dryrun:
 	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu serve --obs-dryrun
+
+# Costscope dryrun (compiler/hardware observatory, ISSUE 15): the static
+# cost plane end-to-end on CPU — AOT-compile every registry entry, extract
+# cost_analysis()/memory_analysis() + the collective-bytes audit, gate the
+# numbers against the committed .costscope_baseline.json (shrink-only debt,
+# graftlint-style), render the roofline report from the committed baseline
+# + banked BENCH_*.json wall-times (no compiles, no hardware), and run the
+# ICI microbench correctness sweep over 8 virtual CPU devices. On real
+# multichip hardware the same `--icibench` (without --dryrun) banks
+# MULTICHIP_ici.json; CI only proves extraction, the gate, and the
+# collective kernels.
+costscope-dryrun:
+	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu costscope \
+	  --no-baseline-growth
+	timeout 120 $(PYTHON) -m kaboodle_tpu costscope --report
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu costscope \
+	  --icibench --dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

@@ -52,6 +52,12 @@ HOT_DIRS = (
     # gone. The asyncio front end (server/client/loadgen/dryrun) is
     # host-side by design; KB301's reachability scoping keeps it quiet.
     "kaboodle_tpu/serve/",
+    # costscope: the observatory is mostly host-side (AOT compile + HLO
+    # text walking), but icibench.py builds the shard_map collective
+    # kernels whose timings BECOME the banked ICI numbers — a host sync
+    # inside those bodies would time the sync, not the collective, and a
+    # dtype drift changes the payload bytes the ring formulas attribute.
+    "kaboodle_tpu/costscope/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the
@@ -89,6 +95,10 @@ DTYPE_DISCIPLINE_FILES = (
     # (engine.py the FILENAME is already listed for oracle/; names match
     # within HOT_DIRS, so serve/engine.py is covered by that entry.)
     "pool.py",
+    # costscope: the microbench payloads. uint32 fingerprints into pmin/
+    # pmax agreement, uint32 all-ones partials into psum_scatter — a
+    # promoted payload doubles the bytes the banked GB/s is computed from.
+    "icibench.py",
 )
 
 _CONSTRUCTORS = {

@@ -385,7 +385,8 @@ def _write_sim_manifests(args, out, metrics, counters, ticks=None,
     summary dict the CLI prints), per-tick records with counters, and the
     flight-recorder dump — plus, for warped runs, one ``warp_spans``
     record per signature class (the per-class leap counters the
-    summarizer aggregates). ``--metrics-jsonl PATH`` gets metrics-only
+    summarizer aggregates) and one ``warp_blocked`` record per blocking
+    term combo (the why-dense histogram). ``--metrics-jsonl PATH`` gets metrics-only
     ``tick`` records — the lightweight lane that needs no telemetry build.
     Both may be given; they are independent files.
     """
@@ -403,6 +404,12 @@ def _write_sim_manifests(args, out, metrics, counters, ticks=None,
             if warp_ledger is not None:
                 for key, agg in sorted(warp_ledger.per_class().items()):
                     w.write("warp_spans", class_key=int(key), **agg)
+                # Why-dense attribution: one record per blocking term combo
+                # (pseudo-terms 'scheduled_event' / 'short_span' included).
+                for term, agg in sorted(
+                    warp_ledger.blocked_histogram().items()
+                ):
+                    w.write("warp_blocked", term=term, **agg)
         print(f"telemetry manifest: {args.telemetry}", file=sys.stderr)
     if args.metrics_jsonl is not None and metrics is not None:
         with ManifestWriter(args.metrics_jsonl) as w:
@@ -440,6 +447,14 @@ def main(argv=None) -> int:
         from kaboodle_tpu.serve.loadgen import main as loadgen_main
 
         return loadgen_main(argv[1:])
+    if argv and argv[0] == "costscope":
+        # Compiler/hardware-plane observatory (costscope/cli.py): static
+        # cost+memory extraction over the graftscan registry gated against
+        # .costscope_baseline.json, the collective-bytes roofline report,
+        # and the ICI microbench.
+        from kaboodle_tpu.costscope.cli import main as costscope_main
+
+        return costscope_main(argv[1:])
     if argv and argv[0] == "phasegraph":
         # Derived-engine dryrun subcommand (phasegraph/dryrun.py): build
         # every engine the planner derives from the op graph at toy N,

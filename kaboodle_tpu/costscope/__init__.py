@@ -1,0 +1,34 @@
+"""costscope — compiler/hardware-plane observatory (ISSUE 15).
+
+Three planes, all riding the graftscan entry-point registry so every
+derived engine is covered for free:
+
+- static cost plane (`extract`): per-entry `cost_analysis()` /
+  `memory_analysis()` numbers, gated against the committed
+  `.costscope_baseline.json` with graftlint-style shrink-only semantics;
+- collective-bytes audit (`collectives`): walk the compiled HLO of the
+  sharded twins and attribute bytes-on-ICI per dispatch per collective;
+- roofline report (`roofline`): combine the static bytes with the banked
+  wall-times from BENCH_*.json to place each kernel against the HBM/ICI
+  floors PERF.md reasons about;
+- ICI microbench (`icibench`): time the two protocol collectives
+  (fingerprint-agreement all-reduce, union reduce-scatter) standalone.
+
+Everything static runs on the CPU backend — no TPU window needed.
+"""
+
+from kaboodle_tpu.costscope.baseline import (  # noqa: F401
+    DEFAULT_BASELINE,
+    gate_measurements,
+    load_baseline,
+    write_baseline,
+)
+from kaboodle_tpu.costscope.collectives import (  # noqa: F401
+    collective_audit,
+    parse_collectives,
+)
+from kaboodle_tpu.costscope.extract import (  # noqa: F401
+    cost_record,
+    extract_entries,
+    static_peak_bytes,
+)

@@ -1,0 +1,151 @@
+"""Collective-bytes audit: walk compiled HLO, attribute bytes-on-ICI.
+
+The sharded twins pay exactly the cross-chip traffic visible in their
+optimized HLO — lines like
+
+    %all-gather.66 = u32[32]{0} all-gather(u32[4]{0} %bitcast.52),
+        channel_id=167, replica_groups=[1,8]<=[8], dimensions={0}
+
+We parse the result shape(s), the collective kind and the replica-group
+size D, then charge per-chip ICI bytes with the standard ring-algorithm
+attribution (R = result bytes per chip):
+
+- all-reduce:          2 * R * (D - 1) / D   (reduce-scatter + all-gather)
+- all-gather:          R * (D - 1) / D       (R is the gathered size)
+- reduce-scatter:      R * (D - 1)           (R is the shard; total S = R*D)
+- all-to-all:          R * (D - 1) / D
+- collective-permute:  R
+
+Static attribution is per compiled occurrence: a collective inside a
+`while` body counts once, so these numbers are bytes *per dispatch* of
+the entry (one tick / one leap), which is the unit PERF.md reasons in.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1,
+    "u8": 1,
+    "s16": 2,
+    "u16": 2,
+    "f16": 2,
+    "bf16": 2,
+    "s32": 4,
+    "u32": 4,
+    "f32": 4,
+    "s64": 8,
+    "u64": 8,
+    "f64": 8,
+    "c64": 8,
+    "c128": 16,
+}
+
+# One HLO instruction: "%name = <result-type> <kind>(operands), attrs".
+# -start/-done async pairs appear on some backends; only the -start (or
+# the plain sync form) carries the transfer, -done is shape-only.
+_INSTR_RE = re.compile(
+    r"=\s*(?P<result>\(?[^=]*?)\s*"
+    r"(?P<kind>" + "|".join(re.escape(k) for k in COLLECTIVE_KINDS) + r")"
+    r"(?P<variant>-start|-done)?\("
+)
+
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[0-9,]*)\]")
+
+_GROUP_PAIR_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_GROUP_SET_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+
+
+def _shape_bytes(result: str) -> int:
+    """Total bytes across every `dtype[dims]` group in a result type."""
+    total = 0
+    for m in _SHAPE_RE.finditer(result):
+        size = _DTYPE_BYTES.get(m.group("dtype"))
+        if size is None:
+            continue
+        dims = m.group("dims")
+        n = 1
+        if dims:
+            n = math.prod(int(d) for d in dims.split(",") if d)
+        total += n * size
+    return total
+
+
+def _group_size(line: str, n_devices: int) -> int:
+    """Participants per replica group (D in the ring formulas)."""
+    m = _GROUP_PAIR_RE.search(line)
+    if m:
+        # iota form [G,D]<=[N]: D participants in each of G groups.
+        return max(int(m.group(2)), 1)
+    m = _GROUP_SET_RE.search(line)
+    if m:
+        first = [d for d in m.group(1).split(",") if d.strip()]
+        return max(len(first), 1)
+    return max(n_devices, 1)
+
+
+def _ici_bytes(kind: str, result_bytes: int, d: int) -> int:
+    if d <= 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * result_bytes * (d - 1) / d)
+    if kind == "all-gather":
+        return int(result_bytes * (d - 1) / d)
+    if kind == "reduce-scatter":
+        return int(result_bytes * (d - 1))
+    if kind == "all-to-all":
+        return int(result_bytes * (d - 1) / d)
+    return int(result_bytes)  # collective-permute: the whole buffer moves
+
+
+def parse_collectives(hlo_text: str, n_devices: int = 1) -> list[dict[str, Any]]:
+    """Parse every byte-moving collective instruction out of HLO text."""
+    out: list[dict[str, Any]] = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if m is None or m.group("variant") == "-done":
+            continue
+        kind = m.group("kind")
+        result_bytes = _shape_bytes(m.group("result"))
+        d = _group_size(line, n_devices)
+        out.append(
+            {
+                "kind": kind,
+                "result_bytes": result_bytes,
+                "group_size": d,
+                "ici_bytes": _ici_bytes(kind, result_bytes, d),
+            }
+        )
+    return out
+
+
+def collective_audit(compiled: Any) -> dict[str, Any]:
+    """Audit one compiled executable: per-kind counts + total ICI bytes."""
+    try:
+        n_devices = len(compiled.input_shardings[0][0].mesh.devices.flat)  # type: ignore[index]
+    except Exception:
+        n_devices = 1
+    rows = parse_collectives(compiled.as_text(), n_devices=n_devices)
+    counts: dict[str, dict[str, int]] = {}
+    total = 0
+    for row in rows:
+        agg = counts.setdefault(
+            row["kind"], {"count": 0, "result_bytes": 0, "ici_bytes": 0}
+        )
+        agg["count"] += 1
+        agg["result_bytes"] += row["result_bytes"]
+        agg["ici_bytes"] += row["ici_bytes"]
+        total += row["ici_bytes"]
+    return {"ici_bytes": total, "counts": counts}

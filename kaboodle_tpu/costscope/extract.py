@@ -1,0 +1,112 @@
+"""Static cost plane: AOT-compile registry entries, extract XLA telemetry.
+
+Every kernel the repo ships is reachable through the graftscan entry-point
+registry (`analysis/ir/registry.py`), so one walk covers dense, fused,
+chunked, sharded, fleet, warp and serve engines.  For each entry we lower
+and compile on the CPU backend and pull out:
+
+- `cost_analysis()`: FLOPs and bytes-accessed of the optimized HLO;
+- `memory_analysis()`: argument / output / temp / generated-code bytes,
+  from which a static peak is derived (what the program needs resident,
+  aliased buffers counted once);
+- the collective walk (`collectives.collective_audit`): bytes-on-ICI.
+
+The numbers are trace-scale (registry TRACE_N=32) — they gate *shape*
+regressions (a doubled dtype, a materialized [N, N] temp, a new
+collective), not absolute production footprints.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+MESH_DEVICES = 8
+
+
+def prepare_backend(devices: int = MESH_DEVICES) -> None:
+    """Pin CPU + virtual multi-device mesh before backend init.
+
+    Safe to call after `import jax` as long as no backend has been
+    created yet (same contract as analysis/ir/scan._prepare_backend);
+    the sharded registry entries need `devices` visible devices.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    try:
+        from axon_guard import strip_axon_plugin
+
+        strip_axon_plugin()
+    except ImportError:
+        pass
+
+
+def static_peak_bytes(mem: Any) -> int:
+    """Static peak-resident estimate from a CompiledMemoryStats.
+
+    argument + output + temp, with donated/aliased bytes counted once.
+    This is what `peak_hbm_mib_static` in bench captures is derived from
+    when the runtime `memory_stats()` comes back empty (the tunnel case).
+    """
+    peak = (
+        int(mem.argument_size_in_bytes)
+        + int(mem.output_size_in_bytes)
+        + int(mem.temp_size_in_bytes)
+        - int(getattr(mem, "alias_size_in_bytes", 0))
+    )
+    return max(peak, 0)
+
+
+def compile_entry(entry: Any) -> Any:
+    """Lower + compile one registry entry with the AOT API."""
+    import jax
+
+    fn, example_args = entry.build()
+    return jax.jit(fn).lower(*example_args).compile()
+
+
+def cost_record(entry: Any, compiled: Any = None) -> dict[str, Any]:
+    """Extract the full static record for one entry.
+
+    `cost_analysis()` returns a list on jax 0.4.x — element 0 holds the
+    dict; keys of interest are 'flops' and 'bytes accessed'.
+    """
+    from kaboodle_tpu.costscope.collectives import collective_audit
+
+    comp = compiled if compiled is not None else compile_entry(entry)
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    mem = comp.memory_analysis()
+    audit = collective_audit(comp)
+    return {
+        "flops": int(ca.get("flops", 0)),
+        "bytes_accessed": int(ca.get("bytes accessed", 0)),
+        "argument_bytes": int(mem.argument_size_in_bytes),
+        "output_bytes": int(mem.output_size_in_bytes),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "generated_code_bytes": int(mem.generated_code_size_in_bytes),
+        "peak_bytes": static_peak_bytes(mem),
+        "ici_bytes": int(audit["ici_bytes"]),
+        "collectives": audit["counts"],
+        "sharded": bool(entry.sharded),
+    }
+
+
+def extract_entries(names: list[str] | None = None) -> dict[str, dict[str, Any]]:
+    """Walk the registry (or a named subset) and extract every record.
+
+    Entries are compiled one at a time; a failure aborts loudly rather
+    than silently shrinking the gated surface.
+    """
+    from kaboodle_tpu.analysis.ir.registry import ENTRY_POINTS, select_entries
+
+    entries = select_entries(names) if names else list(ENTRY_POINTS)
+    out: dict[str, dict[str, Any]] = {}
+    for entry in entries:
+        out[entry.name] = cost_record(entry)
+    return out

@@ -1,0 +1,193 @@
+"""ICI microbench: the two collectives the protocol actually pays.
+
+ROADMAP item 4(b): before a Swing-style schedule (PAPERS.md 2401.09356)
+can be justified, measure what XLA's own choice costs for
+
+1. the fingerprint-agreement all-reduce — the convergence check reduces
+   per-row uint32 fingerprints to a global (min, max) pair every tick
+   (`sharded_convergence_check`); expressed here as shard-local min/max
+   + `lax.pmin`/`lax.pmax` over the peer axis, exactly the reduction
+   GSPMD inserts for the check;
+2. the union reduce-scatter — the join-gossip contraction runs over the
+   sharded axis, so GSPMD reduce-scatters int32 partial unions; here
+   each chip contributes a full [rows, cols] partial and keeps its row
+   block of the sum (`lax.psum_scatter`).
+
+On CPU (`--dryrun`) the sweep runs the small sizes deterministically and
+asserts correctness — CI coverage for the harness itself.  On real
+multi-chip hardware it banks `MULTICHIP_ici.json` (the same artifact
+shape the TPU watcher banks), closing the "B unmeasured" unknown in
+PERF.md's round-5 projection.
+
+Times are whole-dispatch walls (best of `repeats`), so small sizes are
+dispatch-overhead-dominated; the large-size asymptote is the bandwidth
+estimate.  Bytes-on-ICI use the same ring attribution as the static
+audit (collectives.py) so the two planes are directly comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from kaboodle_tpu.costscope.collectives import _ici_bytes
+
+DRYRUN_SIZES = (256, 1024)
+HW_SIZES = (1 << 10, 1 << 14, 1 << 18, 1 << 22)
+UNION_COLS = 64
+BANK_PATH = "MULTICHIP_ici.json"
+
+
+def _mesh(n_devices: int | None = None):
+    from kaboodle_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_devices)
+
+
+def make_agreement_allreduce(mesh):
+    """uint32[n] fingerprints -> replicated (min, max) over the peer axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from kaboodle_tpu.parallel.mesh import PEER_AXIS
+
+    def body(fp):
+        lo = jax.lax.pmin(jnp.min(fp), PEER_AXIS)
+        hi = jax.lax.pmax(jnp.max(fp), PEER_AXIS)
+        return lo, hi
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=P(PEER_AXIS), out_specs=(P(), P())
+        )
+    )
+
+
+def make_union_reduce_scatter(mesh):
+    """int32[D, rows, cols] partials -> summed [rows, cols], row-scattered."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from kaboodle_tpu.parallel.mesh import PEER_AXIS
+
+    def body(part):
+        # Each device holds one [1, rows, cols] partial; psum_scatter sums
+        # across the axis and leaves this device its rows/D block.
+        return jax.lax.psum_scatter(
+            part[0], PEER_AXIS, scatter_dimension=0, tiled=True
+        )
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P(PEER_AXIS, None, None),
+            out_specs=P(PEER_AXIS, None),
+        )
+    )
+
+
+def _time_best(fn, args, repeats: int) -> float:
+    import jax
+
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_sweep(
+    sizes: tuple[int, ...],
+    n_devices: int | None = None,
+    repeats: int = 3,
+    check: bool = True,
+) -> dict[str, Any]:
+    """Time both collectives across `sizes`; optionally assert correctness."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = _mesh(n_devices)
+    d = mesh.size
+    agree = make_agreement_allreduce(mesh)
+    union = make_union_reduce_scatter(mesh)
+    results: list[dict[str, Any]] = []
+    for n in sizes:
+        n = max(n, d)
+        n -= n % d  # shard-divisible
+        fp = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(7)
+        lo, hi = agree(fp)
+        if check:
+            assert int(lo) == 7 and int(hi) == n + 6, (int(lo), int(hi))
+        fp_bytes = n * 4
+        results.append(
+            {
+                "collective": "agreement_all_reduce",
+                "n": int(n),
+                "payload_bytes": fp_bytes,
+                # the check reduces to scalars, but the traffic XLA pays in
+                # the real tick is the [N] uint32 min+max all-reduce pair
+                "ici_bytes_ring": 2 * _ici_bytes("all-reduce", fp_bytes, d),
+                "wall_s_best": round(_time_best(agree, (fp,), repeats), 9),
+            }
+        )
+        rows = max(d, n // UNION_COLS)
+        rows -= rows % d
+        part = jnp.ones((d, rows, UNION_COLS), dtype=jnp.int32)
+        out = union(part)
+        if check:
+            assert out.shape == (rows, UNION_COLS), out.shape
+            assert int(jnp.min(out)) == d == int(jnp.max(out)), (
+                int(jnp.min(out)),
+                d,
+            )
+        total_bytes = rows * UNION_COLS * 4
+        results.append(
+            {
+                "collective": "union_reduce_scatter",
+                "n": int(rows),
+                "payload_bytes": total_bytes,
+                "ici_bytes_ring": _ici_bytes(
+                    "reduce-scatter", total_bytes // d, d
+                ),
+                "wall_s_best": round(_time_best(union, (part,), repeats), 9),
+            }
+        )
+    for r in results:
+        wall = r["wall_s_best"]
+        r["gbps_ring"] = round(r["ici_bytes_ring"] / wall / 1e9, 3) if wall else None
+    return {
+        "schema": "kaboodle-costscope-ici/1",
+        "backend": jax.default_backend(),
+        "n_devices": int(d),
+        "repeats": int(repeats),
+        "results": results,
+    }
+
+
+def bank(report: dict[str, Any], path: str = BANK_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def render(report: dict[str, Any]) -> str:
+    lines = [
+        f"icibench — backend={report['backend']} devices={report['n_devices']} "
+        f"(best of {report['repeats']})",
+        f"{'collective':<24} {'n':>9} {'payload':>12} {'ICI bytes':>11} "
+        f"{'wall':>10} {'ring GB/s':>10}",
+    ]
+    for r in report["results"]:
+        lines.append(
+            f"{r['collective']:<24} {r['n']:>9} {r['payload_bytes']:>12} "
+            f"{r['ici_bytes_ring']:>11} {r['wall_s_best'] * 1e3:>8.3f}ms "
+            f"{r['gbps_ring'] if r['gbps_ring'] is not None else 'n/a':>10}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,185 @@
+"""Roofline report: static bytes vs banked wall-times, no hardware needed.
+
+Combines the committed `.costscope_baseline.json` (trace-scale static
+bytes / FLOPs / ICI bytes per entry) with the wall-times already banked
+in BENCH_*.json to place each kernel against the floors PERF.md reasons
+about:
+
+- HBM floor: the measured effective sweep bandwidth (~271 GB/s through
+  this stack on the v5e capture, PERF.md round-5) — static bytes / BW is
+  the floor time a dispatch cannot beat;
+- ICI floor: per-chip link bandwidth is the one *remaining* unknown
+  (public order 1e2 GB/s); the report quotes floor times at the 50 and
+  100 GB/s bookends until `icibench` banks a measurement.
+
+Banked walls come from any BENCH_*.json record carrying the
+`simulated_peers_ticks_per_sec_per_chip` metric (s/tick/chip =
+n_peers / value), so the report runs entirely from committed artifacts.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any
+
+# Measured effective HBM bandwidth (PERF.md round-5: int8 [N,N] r+w sweep
+# 1.93 ms at N=16,384 => ~271 GB/s through this stack).
+EFFECTIVE_HBM_GBPS = 271.0
+# ICI bookends: per-chip link bandwidth is unmeasured (ROADMAP item 4b).
+ICI_GBPS_BOOKENDS = (50.0, 100.0)
+
+WALL_METRIC = "simulated_peers_ticks_per_sec_per_chip"
+
+
+def load_bench_walls(root: str = ".") -> list[dict[str, Any]]:
+    """Scan BENCH_*.json for banked per-tick wall-times."""
+    walls: list[dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        try:
+            data = json.loads(open(path).read())
+        except (OSError, ValueError):
+            continue
+        records = data if isinstance(data, list) else [data]
+        for rec in records:
+            if not isinstance(rec, dict) or rec.get("metric") != WALL_METRIC:
+                continue
+            value = rec.get("value") or 0
+            n_peers = rec.get("n_peers") or 0
+            if value <= 0 or n_peers <= 0:
+                continue
+            walls.append(
+                {
+                    "source": os.path.basename(path),
+                    "backend": rec.get("backend", "?"),
+                    "n_peers": int(n_peers),
+                    "peers_ticks_per_s": float(value),
+                    "s_per_tick": n_peers / float(value),
+                }
+            )
+    return walls
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+def build_report(
+    entries: dict[str, dict[str, Any]],
+    walls: list[dict[str, Any]],
+    trace_n: int,
+) -> dict[str, Any]:
+    """Assemble the roofline structure from static records + banked walls."""
+    rows = []
+    for name in sorted(entries):
+        rec = entries[name]
+        bytes_accessed = int(rec.get("bytes_accessed", 0))
+        flops = int(rec.get("flops", 0))
+        ici = int(rec.get("ici_bytes", 0))
+        row: dict[str, Any] = {
+            "entry": name,
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "peak_bytes": int(rec.get("peak_bytes", 0)),
+            "ici_bytes": ici,
+            "sharded": bool(rec.get("sharded", False)),
+            "intensity_flops_per_byte": (
+                round(flops / bytes_accessed, 4) if bytes_accessed else 0.0
+            ),
+            "hbm_floor_us": round(bytes_accessed / EFFECTIVE_HBM_GBPS / 1e3, 3),
+        }
+        if ici:
+            row["ici_floor_us"] = {
+                f"{int(b)}GBps": round(ici / b / 1e3, 3) for b in ICI_GBPS_BOOKENDS
+            }
+        rows.append(row)
+
+    # Place the banked steady-tick walls against the HBM floor: the static
+    # bytes are trace-scale, and the dominant traffic is the [N, N] state
+    # sweeps, so scale by (n_peers / TRACE_N)^2 to the capture's N.
+    placements = []
+    tick_entries = [r for r in rows if r["entry"].endswith("tick.faulty")]
+    for wall in walls:
+        for r in tick_entries:
+            scale = (wall["n_peers"] / trace_n) ** 2
+            floor_s = r["bytes_accessed"] * scale / (EFFECTIVE_HBM_GBPS * 1e9)
+            placements.append(
+                {
+                    "entry": r["entry"],
+                    "source": wall["source"],
+                    "backend": wall["backend"],
+                    "n_peers": wall["n_peers"],
+                    "wall_s_per_tick": round(wall["s_per_tick"], 6),
+                    "hbm_floor_s_per_tick": round(floor_s, 6),
+                    "wall_over_floor": (
+                        round(wall["s_per_tick"] / floor_s, 2)
+                        if floor_s > 0
+                        else None
+                    ),
+                }
+            )
+    return {
+        "schema": "kaboodle-costscope-roofline/1",
+        "trace_n": trace_n,
+        "effective_hbm_gbps": EFFECTIVE_HBM_GBPS,
+        "ici_gbps_bookends": list(ICI_GBPS_BOOKENDS),
+        "entries": rows,
+        "banked_walls": walls,
+        "placements": placements,
+    }
+
+
+def render_report(report: dict[str, Any]) -> str:
+    """Human table: the per-entry static plane, then banked placements."""
+    lines = [
+        f"costscope roofline — trace N={report['trace_n']}, "
+        f"HBM {report['effective_hbm_gbps']:.0f} GB/s effective, "
+        f"ICI bookends {report['ici_gbps_bookends']} GB/s",
+        "",
+        f"{'entry':<34} {'flops':>12} {'bytes':>12} {'peak':>12} "
+        f"{'ICI':>10} {'fl/B':>7} {'HBMfloor':>9}",
+    ]
+    for r in report["entries"]:
+        lines.append(
+            f"{r['entry']:<34} {r['flops']:>12} "
+            f"{_fmt_bytes(r['bytes_accessed']):>12} "
+            f"{_fmt_bytes(r['peak_bytes']):>12} "
+            f"{_fmt_bytes(r['ici_bytes']):>10} "
+            f"{r['intensity_flops_per_byte']:>7.3f} "
+            f"{r['hbm_floor_us']:>7.1f}us"
+        )
+    if report["placements"]:
+        lines += ["", "banked walls vs HBM floor (bytes scaled (N/traceN)^2):"]
+        for p in report["placements"]:
+            ratio = p["wall_over_floor"]
+            lines.append(
+                f"  {p['source']:<24} {p['backend']:<5} N={p['n_peers']:<7} "
+                f"wall {p['wall_s_per_tick'] * 1e3:8.3f} ms/tick   "
+                f"floor {p['hbm_floor_s_per_tick'] * 1e3:8.3f} ms   "
+                f"wall/floor {ratio if ratio is not None else 'n/a'}"
+            )
+    elif not report["banked_walls"]:
+        lines += ["", "no banked BENCH_*.json walls found — static plane only"]
+    sharded = [r for r in report["entries"] if r["sharded"]]
+    if sharded:
+        lines += ["", "per-collective ICI floors (sharded entries):"]
+        for r in sharded:
+            floors = r.get("ici_floor_us")
+            if floors:
+                body = ", ".join(f"{k}: {v}us" for k, v in floors.items())
+                lines.append(f"  {r['entry']:<34} {body}")
+    return "\n".join(lines)
+
+
+def roofline_from_baseline(
+    baseline: dict[str, Any], root: str = "."
+) -> dict[str, Any]:
+    from kaboodle_tpu.analysis.ir.registry import TRACE_N
+
+    walls = load_bench_walls(root)
+    return build_report(baseline["entries"], walls, trace_n=TRACE_N)

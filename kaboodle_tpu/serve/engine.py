@@ -82,6 +82,7 @@ from kaboodle_tpu.telemetry.manifest import run_record
 from kaboodle_tpu.warp.horizon import decode_signature
 from kaboodle_tpu.warp.runner import (
     MIN_LEAP,
+    WarpLedger,
     _classify,
     _fleet_signature,
     _get_fleet_leap,
@@ -215,6 +216,11 @@ class ServeEngine:
         self._requests: OrderedDict[int, dict] = OrderedDict()
         # (n_class, lane) -> rid for lanes currently occupied by a request.
         self._lane_owner: dict[tuple[int, int], int] = {}
+        # Why-dense ledger (ISSUE 15): when a round falls back from leap to
+        # chunk, the blocking signature terms are recorded per horizon lane
+        # — host-side bookkeeping only, engine state stays bit-identical.
+        # Surfaced by the obs plane's warp_blocked_* gauges.
+        self.warp_ledger = WarpLedger()
         # Observability plane (ISSUE 14): obs=True gets the defaults,
         # obs=ObsPlane(...) a configured one. The plane is a pure observer
         # — engine state is bit-identical with it on or off — and its
@@ -819,6 +825,7 @@ class ServeEngine:
         k_m = np.zeros((pool.lanes,), dtype=np.int32)
         tracing = self.obs is not None and self.obs.trace
         classes: list[dict] = []
+        decoded: list[tuple] = []  # (cls, mode) per horizon lane
         for e in np.flatnonzero(horizon):
             cls = decode_signature(rows[e])
             mode = _classify(cls, hybrid=True)
@@ -827,12 +834,20 @@ class ServeEngine:
                     _leap_budget(cls, mode, int(pool.remaining[e])),
                     self.max_leap,
                 )
+            decoded.append((cls, mode))
             if tracing:
                 classes.append({
                     "lane": int(e), "k": int(k_m[e]), "mode": mode,
                     "class_key": cls.key, "terms": cls.describe()["terms"],
                 })
         if k_m.max() < MIN_LEAP:
+            # Round falls back to the chunk engine: attribute the dense
+            # ticks each horizon lane is about to pay (pool.chunk) to the
+            # signature terms that blocked its leap — host-side only.
+            for cls, mode in decoded:
+                self.warp_ledger.record_blocked(
+                    cls, pool.chunk, "serve", mode=mode
+                )
             return False
         K = 1 << int(k_m.max() - 1).bit_length()
         K = max(K, MIN_LEAP)

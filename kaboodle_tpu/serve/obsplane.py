@@ -424,6 +424,33 @@ class ObsPlane:
         m.register_gauge("warp_leap_cache_hits", _leap_cache("hits"))
         m.register_gauge("warp_leap_cache_misses", _leap_cache("misses"))
         m.register_gauge("warp_leap_cache_programs", _leap_cache("programs"))
+
+        def _cache_kind_hit_rates():
+            from kaboodle_tpu.warp.runner import leap_cache
+
+            return {
+                (("kind", kind),): st["hit_rate"]
+                for kind, st in leap_cache.stats()["per_kind"].items()
+            }
+
+        # Per-class cache hit rates (strict / hybrid / fleet programs) and
+        # the why-dense histogram (ISSUE 15): which signature terms forced
+        # leap->chunk fallbacks, labeled by blocking term combo.
+        m.register_multi_gauge(
+            "warp_leap_cache_hit_rate", _cache_kind_hit_rates)
+
+        def _blocked(field):
+            def read():
+                return {
+                    (("term", term),): agg[field]
+                    for term, agg in
+                    engine.warp_ledger.blocked_histogram().items()
+                }
+
+            return read
+
+        m.register_multi_gauge("warp_blocked_ticks", _blocked("ticks"))
+        m.register_multi_gauge("warp_blocked_spans", _blocked("spans"))
         m.register_gauge("compiles_steady", lambda: self._compiles.count)
         for i, seg in enumerate(SEGMENTS):
             m.attach_histogram("serve_round_segment_us",

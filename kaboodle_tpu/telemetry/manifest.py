@@ -80,6 +80,24 @@ def validate_record(rec) -> dict:
                 raise ValueError(
                     f"'serve_span' records need an integer {field!r}"
                 )
+    if kind == "warp_blocked":
+        # Why-dense attribution rows (warp/runner.py WarpLedger): one per
+        # blocking term combo, summed over the run's dense spans.
+        if not isinstance(rec.get("term"), str) or not rec.get("term"):
+            raise ValueError(
+                "'warp_blocked' records need a non-empty string 'term'"
+            )
+        for field in ("ticks", "spans"):
+            if not isinstance(rec.get(field), int):
+                raise ValueError(
+                    f"'warp_blocked' records need an integer {field!r}"
+                )
+    if kind == "costscope":
+        # Static compiler-plane records (costscope/cli.py --manifest).
+        if not isinstance(rec.get("entry"), str) or not rec.get("entry"):
+            raise ValueError(
+                "'costscope' records need a non-empty string 'entry'"
+            )
     return rec
 
 

@@ -188,6 +188,7 @@ def summarize(records: dict[str, list[dict]]) -> dict:
     runs: list[dict] = []
     ticks: list[dict] = []
     warp_spans: list[dict] = []
+    warp_blocked: list[dict] = []
     serve_events: list[dict] = []
     serve_rounds: list[dict] = []
     for recs in records.values():
@@ -199,6 +200,8 @@ def summarize(records: dict[str, list[dict]]) -> dict:
                 ticks.append(rec)
             elif rec["kind"] == "warp_spans":
                 warp_spans.append(rec)
+            elif rec["kind"] == "warp_blocked":
+                warp_blocked.append(rec)
             elif rec["kind"] == "serve_event":
                 serve_events.append(rec)
             elif rec["kind"] == "serve_round":
@@ -228,6 +231,18 @@ def summarize(records: dict[str, list[dict]]) -> dict:
             for f in ("spans", "ticks", "dispatches"):
                 agg[f] += int(rec.get(f, 0))
         out["leap_classes"] = {str(k): v for k, v in sorted(classes.items())}
+    if warp_blocked:
+        # Why-dense attribution: which signature terms kept spans off the
+        # leap path (plus the 'scheduled_event' / 'short_span' pseudo-terms),
+        # aggregated across manifests. Ticks sum to the dense ticks executed.
+        terms: dict = {}
+        for rec in warp_blocked:
+            agg = terms.setdefault(
+                str(rec["term"]), {"spans": 0, "ticks": 0, "members": 0}
+            )
+            for f in ("spans", "ticks", "members"):
+                agg[f] += int(rec.get(f, 0))
+        out["warp_blocked"] = dict(sorted(terms.items()))
     if serve_events or serve_rounds:
         # Serve-lane aggregation: request lifecycle counts, completed-run
         # tick stats, and per-engine round totals (chunk vs leap ticks —
@@ -301,6 +316,17 @@ def main(argv=None) -> int:
         if "final_converged" in summary:
             print(f"  first_converged_tick={summary.get('first_converged_tick')}"
                   f" final_converged={summary.get('final_converged')}")
+
+    if "warp_blocked" in summary:
+        total = sum(v["ticks"] for v in summary["warp_blocked"].values())
+        print(f"  why-dense ({total} dense ticks):")
+        for term, agg in sorted(
+            summary["warp_blocked"].items(),
+            key=lambda kv: -kv[1]["ticks"],
+        ):
+            share = 100.0 * agg["ticks"] / max(total, 1)
+            print(f"    {term:<32} {agg['ticks']:>7} ticks "
+                  f"({share:5.1f}%) over {agg['spans']} spans")
 
     if "serve" in summary:
         s = summary["serve"]

@@ -93,6 +93,7 @@ class ProgramCache:
         self._programs: dict = {}
         self.hits = 0
         self.misses = 0
+        self._kind_stats: dict[str, list[int]] = {}  # kind -> [hits, misses]
 
     def get(self, family, kind: str, k: int, build):
         if k not in CHUNK_BUCKETS:
@@ -101,11 +102,14 @@ class ProgramCache:
                 "— the program cache only admits bucketed span lengths"
             )
         key = (family, kind, k)
+        kind_stats = self._kind_stats.setdefault(kind, [0, 0])
         prog = self._programs.get(key)
         if prog is not None:
             self.hits += 1
+            kind_stats[0] += 1
             return prog
         self.misses += 1
+        kind_stats[1] += 1
         prog = build()
         self._programs[key] = prog
         return prog
@@ -115,17 +119,27 @@ class ProgramCache:
         for family, kind, _k in self._programs:
             fam = families.setdefault(repr((family, kind)), 0)
             families[repr((family, kind))] = fam + 1
+        per_kind = {
+            kind: {
+                "hits": h,
+                "misses": m,
+                "hit_rate": round(h / (h + m), 4) if h + m else 0.0,
+            }
+            for kind, (h, m) in sorted(self._kind_stats.items())
+        }
         return {
             "programs": len(self._programs),
             "per_family_bound": len(CHUNK_BUCKETS),
             "max_family_programs": max(families.values(), default=0),
             "hits": self.hits,
             "misses": self.misses,
+            "per_kind": per_kind,
         }
 
     def clear(self) -> None:
         self._programs.clear()
         self.hits = self.misses = 0
+        self._kind_stats.clear()
 
 
 leap_cache = ProgramCache()
@@ -205,9 +219,22 @@ class WarpLedger:
     Filled by the runners when passed as ``ledger=``; feeds the telemetry
     summarizer's per-class leap counters and the bench arms' span
     accounting. ``spans`` rows: dict(engine, class_key, class, ticks,
-    dispatches)."""
+    dispatches).
+
+    **Why-dense attribution** (the evidence base for ROADMAP item 2's RNG
+    re-keying): every dense span is also attributed to the signature
+    terms that forced it — ``blocked`` rows name the blocking term combo
+    (``decode_signature`` names the terms), plus two pseudo-terms the
+    signature cannot see: ``scheduled_event`` (the schedule itself made
+    the tick dense — recorded WITHOUT a signature fetch, preserving the
+    one-fetch-per-span budget) and ``short_span`` (a leapable class whose
+    budget was under ``MIN_LEAP``). The histogram is exact by
+    construction: summed ``ticks`` equal the dense ticks executed.
+    Host-side only — recording never changes what dispatches, so ledger
+    on/off runs stay bit-identical."""
 
     spans: list = dataclasses.field(default_factory=list)
+    blocked: list = dataclasses.field(default_factory=list)
 
     def record(self, cls: ActivityClass, engine: str, ticks: int, dispatches: int) -> None:
         self.spans.append({
@@ -217,6 +244,45 @@ class WarpLedger:
             "ticks": int(ticks),
             "dispatches": int(dispatches),
         })
+
+    def record_blocked(
+        self,
+        cls: ActivityClass | None,
+        ticks: int,
+        engine: str,
+        mode: str = "dense",
+        members: int = 1,
+    ) -> None:
+        """One dense span: which term kept it off the leap path.
+
+        ``cls=None`` marks an eventful tick (no signature was fetched);
+        a leapable ``mode`` marks a budget under ``MIN_LEAP``."""
+        if cls is None:
+            term, key = "scheduled_event", -1
+        elif mode != "dense":
+            term, key = "short_span", cls.key
+        else:
+            term, key = "+".join(cls.describe()["terms"]), cls.key
+        self.blocked.append({
+            "engine": engine,
+            "term": term,
+            "class_key": key,
+            "ticks": int(ticks),
+            "spans": 1,
+            "members": int(members),
+        })
+
+    def blocked_histogram(self) -> dict:
+        """``{term: {spans, ticks, members}}`` — the why-dense histogram."""
+        out: dict = {}
+        for row in self.blocked:
+            agg = out.setdefault(
+                row["term"], {"spans": 0, "ticks": 0, "members": 0}
+            )
+            agg["spans"] += row["spans"]
+            agg["ticks"] += row["ticks"]
+            agg["members"] += row["members"]
+        return out
 
     def per_class(self) -> dict:
         """``{class_key: {engine, terms, spans, ticks, dispatches}}`` totals."""
@@ -339,8 +405,14 @@ def simulate_warped(
                     on_boundary(t, state)
                 continue
             stop = min(span_end, t + recheck_every)
+            if ledger is not None:
+                ledger.record_blocked(cls, stop - t, "sim", mode=mode)
         else:
             stop = t + 1
+            if ledger is not None:
+                # Eventful tick: the schedule forced it dense — no
+                # signature fetch (the one-fetch-per-span budget holds).
+                ledger.record_blocked(None, 1, "sim")
         with host_span("dense_span"):
             while t < stop:
                 state, m = tick(state, _slice_tick(inputs, t))
@@ -402,6 +474,8 @@ def run_warped(
             t += k - rem
             continue
         stop = min(ticks, t + recheck_every)
+        if ledger is not None:
+            ledger.record_blocked(cls, stop - t, "steady", mode=mode)
         while t < stop:
             state, _ = tick(state, idle)
             t += 1
@@ -560,6 +634,21 @@ def run_fleet_warped(
         # for them), everyone else frozen.
         steps = int(min(recheck_every, remaining[remaining > 0].min()))
         active = jnp.asarray(remaining > 0)
+        if ledger is not None:
+            # Attribute the dense round per blocking class: every active
+            # member pays ``steps`` dense ticks, aggregated over the class
+            # mix (leapable-but-short free riders land on ``short_span``).
+            per_round: dict = {}
+            for e, cls in enumerate(classes):
+                if remaining[e] <= 0:
+                    continue
+                mode = _classify(cls, hybrid)
+                row = per_round.setdefault((cls.key, mode), [cls, mode, 0])
+                row[2] += 1
+            for cls, mode, members in per_round.values():
+                ledger.record_blocked(
+                    cls, steps * members, "fleet", mode=mode, members=members
+                )
         for _ in range(steps):
             mesh_state = _masked_fleet_tick(cfg)(mesh_state, idle, active)
     converged = _fleet_converged()(mesh_state)

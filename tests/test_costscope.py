@@ -1,0 +1,454 @@
+"""costscope (kaboodle_tpu/costscope) — static cost plane, gate, why-dense.
+
+The acceptance contract is seeded-regression-tested like graftlint's: a
+doctored baseline (the seeded regression — the live program looks like it
+doubled a buffer) must turn the CLI gate red, and the honest baseline must
+pass. The collective walk is pinned two ways: synthetic HLO lines with
+known byte counts, and real compiled registry twins — every sharded entry
+must show nonzero bytes-on-ICI and every single-device entry exactly zero
+(the committed `.costscope_baseline.json` is asserted to satisfy the same
+invariant). The why-dense ledger is parity-gated: summed blocked ticks
+equal the dense tick count exactly, and a ledger-carrying run ends
+bit-identical to a bare one.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+import jax
+
+from kaboodle_tpu.costscope.baseline import (
+    BASELINE_SCHEMA,
+    GATED_FIELDS,
+    gate_measurements,
+    load_baseline,
+    write_baseline,
+)
+from kaboodle_tpu.costscope.collectives import (
+    _ici_bytes,
+    parse_collectives,
+)
+from kaboodle_tpu.costscope.extract import (
+    extract_entries,
+    static_peak_bytes,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Collective walk: synthetic HLO with known byte counts.
+
+SYNTH_HLO = """\
+HloModule synth
+
+ENTRY main {
+  %p0 = u32[32]{0} parameter(0)
+  %all-reduce.1 = u32[32]{0} all-reduce(u32[32]{0} %p0), channel_id=1, replica_groups=[1,8]<=[8], to_apply=%min
+  %all-gather.2 = u32[64]{0} all-gather(u32[8]{0} %p0), channel_id=2, replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+  %reduce-scatter.3 = f32[16]{0} reduce-scatter(f32[128]{0} %p0), channel_id=3, replica_groups=[1,8]<=[8], to_apply=%add
+  %collective-permute.4 = s8[100]{0} collective-permute(s8[100]{0} %p0), channel_id=4, source_target_pairs={{0,1}}
+  %all-reduce-start.5 = u32[4]{0} all-reduce-start(u32[4]{0} %p0), channel_id=5, replica_groups=[1,4]<=[4], to_apply=%min
+  %all-reduce-done.6 = u32[4]{0} all-reduce-done(u32[4]{0} %all-reduce-start.5)
+}
+"""
+
+
+def test_parse_collectives_synthetic():
+    rows = parse_collectives(SYNTH_HLO, n_devices=8)
+    by_kind = {}
+    for r in rows:
+        by_kind.setdefault(r["kind"], []).append(r)
+
+    # all-reduce over u32[32] on a ring of 8: 2 * 128 * 7/8 = 224.
+    ar = by_kind["all-reduce"][0]
+    assert (ar["result_bytes"], ar["group_size"], ar["ici_bytes"]) == (128, 8, 224)
+    # all-gather result u32[64], explicit groups of 4: 256 * 3/4 = 192.
+    ag = by_kind["all-gather"][0]
+    assert (ag["result_bytes"], ag["group_size"], ag["ici_bytes"]) == (256, 4, 192)
+    # reduce-scatter shard f32[16]: 64 * (8-1) = 448.
+    rs = by_kind["reduce-scatter"][0]
+    assert (rs["result_bytes"], rs["group_size"], rs["ici_bytes"]) == (64, 8, 448)
+    # collective-permute moves the whole s8[100] buffer.
+    cp = by_kind["collective-permute"][0]
+    assert (cp["result_bytes"], cp["ici_bytes"]) == (100, 100)
+    # The async pair counts once: the -start carries the transfer, the
+    # -done is shape-only and must be skipped.
+    assert len(by_kind["all-reduce"]) == 2
+    assert by_kind["all-reduce"][1]["group_size"] == 4
+
+
+def test_ici_ring_formulas():
+    assert _ici_bytes("all-reduce", 1024, 8) == int(2 * 1024 * 7 / 8)
+    assert _ici_bytes("all-gather", 1024, 8) == int(1024 * 7 / 8)
+    assert _ici_bytes("reduce-scatter", 1024, 8) == 1024 * 7
+    assert _ici_bytes("all-to-all", 1024, 8) == int(1024 * 7 / 8)
+    assert _ici_bytes("collective-permute", 1024, 8) == 1024
+    # A degenerate one-participant group moves nothing.
+    assert _ici_bytes("all-reduce", 1024, 1) == 0
+
+
+def test_static_peak_bytes():
+    class Mem:
+        argument_size_in_bytes = 100
+        output_size_in_bytes = 40
+        temp_size_in_bytes = 60
+        alias_size_in_bytes = 40
+
+    assert static_peak_bytes(Mem()) == 160
+
+    class NoAlias:
+        argument_size_in_bytes = 10
+        output_size_in_bytes = 10
+        temp_size_in_bytes = 0
+
+    assert static_peak_bytes(NoAlias()) == 20
+
+
+# ---------------------------------------------------------------------------
+# Extraction on real registry entries (trace scale; conftest pins the
+# 8-device virtual mesh the sharded twins need).
+
+
+def test_golden_crc32_extract():
+    rec = extract_entries(["ops.crc32"])["ops.crc32"]
+    assert rec["flops"] > 0
+    assert rec["bytes_accessed"] > 0
+    assert rec["peak_bytes"] > 0
+    assert rec["sharded"] is False
+    assert rec["ici_bytes"] == 0 and rec["collectives"] == {}
+    # Static extraction is deterministic: a second compile of the same
+    # entry yields the identical record.
+    assert extract_entries(["ops.crc32"])["ops.crc32"] == rec
+
+
+def test_sharded_entry_pays_ici_single_device_does_not():
+    recs = extract_entries(["phasegraph.tick.sharded", "phasegraph.tick.faulty"])
+    sh = recs["phasegraph.tick.sharded"]
+    dn = recs["phasegraph.tick.faulty"]
+    assert sh["sharded"] and sh["ici_bytes"] > 0
+    # The sharded tick's cross-chip traffic is the spec-derived halo
+    # exchange + convergence check: all-gather and all-reduce must both
+    # appear in the walk.
+    assert "all-gather" in sh["collectives"]
+    assert "all-reduce" in sh["collectives"]
+    assert not dn["sharded"]
+    assert dn["ici_bytes"] == 0 and dn["collectives"] == {}
+
+
+@pytest.mark.slow
+def test_full_registry_extract_matches_committed_invariant():
+    measured = extract_entries(None)
+    from kaboodle_tpu.analysis.ir.registry import ENTRY_POINTS
+
+    assert set(measured) == {e.name for e in ENTRY_POINTS}
+    for name, rec in measured.items():
+        if rec["sharded"]:
+            assert rec["ici_bytes"] > 0, f"{name}: sharded but zero ICI bytes"
+        else:
+            assert rec["ici_bytes"] == 0, f"{name}: single-device but ICI bytes"
+
+
+def test_committed_baseline_invariant():
+    """The committed baseline satisfies the same sharded/ICI invariant."""
+    data = load_baseline(REPO / ".costscope_baseline.json")
+    assert data is not None and data["schema"] == BASELINE_SCHEMA
+    entries = data["entries"]
+    assert len(entries) >= 28
+    for name, rec in entries.items():
+        if rec["sharded"]:
+            assert rec["ici_bytes"] > 0, name
+        else:
+            assert rec["ici_bytes"] == 0, name
+
+
+# ---------------------------------------------------------------------------
+# Gate semantics on synthetic records.
+
+
+def _rec(**over):
+    base = {
+        "flops": 1000,
+        "bytes_accessed": 100_000,
+        "peak_bytes": 200_000,
+        "ici_bytes": 50_000,
+        "sharded": True,
+    }
+    base.update(over)
+    return base
+
+
+def test_gate_unbaselined_entry_fails():
+    fails = gate_measurements({"e": _rec()}, None)
+    assert len(fails) == 1 and "no baseline" in fails[0]
+    fails = gate_measurements(
+        {"e": _rec()}, {"schema": BASELINE_SCHEMA, "entries": {}}
+    )
+    assert len(fails) == 1 and "not in baseline" in fails[0]
+
+
+def test_gate_within_tolerance_passes():
+    baseline = {"schema": BASELINE_SCHEMA, "entries": {"e": _rec()}}
+    wobble = _rec(bytes_accessed=102_000, peak_bytes=198_000, ici_bytes=51_000)
+    assert gate_measurements({"e": wobble}, baseline) == []
+    assert gate_measurements({"e": wobble}, baseline, no_growth=True) == []
+
+
+def test_gate_growth_fails():
+    baseline = {"schema": BASELINE_SCHEMA, "entries": {"e": _rec()}}
+    fails = gate_measurements({"e": _rec(bytes_accessed=200_000)}, baseline)
+    assert len(fails) == 1 and "grew" in fails[0]
+    # Every gated field is watched independently.
+    grown = _rec(
+        bytes_accessed=200_000, peak_bytes=400_000, ici_bytes=100_000
+    )
+    assert len(gate_measurements({"e": grown}, baseline)) == len(GATED_FIELDS)
+
+
+def test_gate_shrink_only_under_no_growth():
+    baseline = {"schema": BASELINE_SCHEMA, "entries": {"e": _rec()}}
+    shrunk = {"e": _rec(bytes_accessed=50_000)}
+    assert gate_measurements(shrunk, baseline) == []
+    fails = gate_measurements(shrunk, baseline, no_growth=True)
+    assert len(fails) == 1 and "shrank" in fails[0]
+
+
+def test_gate_stale_entry_under_no_growth():
+    baseline = {
+        "schema": BASELINE_SCHEMA,
+        "entries": {"e": _rec(), "gone": _rec()},
+    }
+    live = {"e": _rec()}
+    assert gate_measurements(live, baseline) == []
+    fails = gate_measurements(live, baseline, no_growth=True)
+    assert len(fails) == 1 and "stale" in fails[0]
+    # --entry subsets are deliberately partial: no stale check.
+    assert gate_measurements(live, baseline, no_growth=True, subset=True) == []
+
+
+def test_baseline_roundtrip_and_bad_schema(tmp_path):
+    path = tmp_path / "b.json"
+    assert load_baseline(path) is None
+    write_baseline(path, {"e": _rec()})
+    data = load_baseline(path)
+    assert data["entries"]["e"]["bytes_accessed"] == 100_000
+    path.write_text(json.dumps({"schema": "wrong/1", "entries": {}}))
+    with pytest.raises(ValueError):
+        load_baseline(path)
+
+
+# ---------------------------------------------------------------------------
+# Seeded regression through the CLI (the acceptance gate, in-process).
+
+
+def test_seeded_regression_turns_cli_gate_red(tmp_path, capsys):
+    from kaboodle_tpu.costscope.cli import main
+
+    # ops.fused_fp is big enough (~300 KB accessed) that a halved
+    # baseline clears the gate's absolute jitter floor.
+    honest = extract_entries(["ops.fused_fp"])
+    path = tmp_path / "base.json"
+
+    # Honest baseline: the subset gate is green, shrink-ratchet included.
+    write_baseline(path, honest)
+    rc = main(
+        ["--entry", "ops.fused_fp", "--baseline", str(path),
+         "--no-baseline-growth"]
+    )
+    assert rc == 0
+
+    # Seeded regression: the baseline says the program used to touch half
+    # the bytes (equivalently, the live program doubled a buffer dtype).
+    doctored = {
+        "ops.fused_fp": {
+            **honest["ops.fused_fp"],
+            "bytes_accessed": honest["ops.fused_fp"]["bytes_accessed"] // 2,
+            "peak_bytes": honest["ops.fused_fp"]["peak_bytes"] // 2,
+        }
+    }
+    write_baseline(path, doctored)
+    rc = main(["--entry", "ops.fused_fp", "--baseline", str(path)])
+    assert rc == 1
+    assert "grew" in capsys.readouterr().out
+
+    # Unknown entry / corrupt baseline are usage errors, not regressions.
+    assert main(["--entry", "no.such.entry", "--baseline", str(path)]) == 2
+    path.write_text("{\"schema\": \"wrong/1\"}")
+    assert main(["--entry", "ops.fused_fp", "--baseline", str(path)]) == 2
+
+
+def test_cli_routes_through_package_main(tmp_path):
+    """`python -m kaboodle_tpu costscope ...` reaches the same gate."""
+    from kaboodle_tpu.cli import main as pkg_main
+
+    honest = extract_entries(["ops.fused_fp"])
+    path = tmp_path / "base.json"
+    doctored = {
+        "ops.fused_fp": {
+            **honest["ops.fused_fp"],
+            "bytes_accessed": honest["ops.fused_fp"]["bytes_accessed"] // 2,
+        }
+    }
+    write_baseline(path, doctored)
+    rc = pkg_main(
+        ["costscope", "--entry", "ops.fused_fp", "--baseline", str(path)]
+    )
+    assert rc == 1
+
+
+def test_cli_write_baseline_merges_subset(tmp_path):
+    from kaboodle_tpu.costscope.cli import main
+
+    path = tmp_path / "base.json"
+    write_baseline(path, {"other.entry": _rec()})
+    rc = main(
+        ["--entry", "ops.crc32", "--baseline", str(path), "--write-baseline"]
+    )
+    assert rc == 0
+    data = load_baseline(path)
+    assert set(data["entries"]) == {"other.entry", "ops.crc32"}
+
+
+# ---------------------------------------------------------------------------
+# Roofline: runs from the committed baseline + banked walls, no hardware.
+
+
+def test_roofline_from_committed_baseline():
+    from kaboodle_tpu.costscope.roofline import (
+        load_bench_walls,
+        render_report,
+        roofline_from_baseline,
+    )
+
+    baseline = load_baseline(REPO / ".costscope_baseline.json")
+    report = roofline_from_baseline(baseline, root=str(REPO))
+    rows = {r["entry"]: r for r in report["entries"]}
+    assert set(rows) == set(baseline["entries"])
+    for name, row in rows.items():
+        assert row["hbm_floor_us"] > 0, name
+        if baseline["entries"][name]["sharded"]:
+            floors = row["ici_floor_us"]
+            # The slower bookend (50 GB/s) bounds the floor from above.
+            assert floors["50GBps"] > floors["100GBps"] > 0, name
+    text = render_report(report)
+    assert "phasegraph.tick.sharded" in text
+    # Banked walls exist in-repo, so the wall-vs-floor placements render.
+    assert load_bench_walls(str(REPO))
+    assert report["placements"]
+
+
+# ---------------------------------------------------------------------------
+# ICI microbench: correctness-asserted dryrun on the virtual mesh.
+
+
+def test_icibench_dryrun_sweep():
+    from kaboodle_tpu.costscope.icibench import run_sweep
+
+    out = run_sweep(sizes=(256,), repeats=1, check=True)
+    assert out["schema"] == "kaboodle-costscope-ici/1"
+    assert out["n_devices"] == 8
+    kinds = {r["collective"] for r in out["results"]}
+    assert kinds == {"agreement_all_reduce", "union_reduce_scatter"}
+    for r in out["results"]:
+        assert r["payload_bytes"] > 0
+        assert r["ici_bytes_ring"] > 0
+        assert r["wall_s_best"] > 0
+        assert r["gbps_ring"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Why-dense attribution: parity + obs-neutrality.
+
+
+def _churn_setup():
+    import jax.numpy as jnp  # noqa: F401
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.scenario import Scenario
+    from kaboodle_tpu.sim.state import init_state
+
+    n, ticks = 32, 96
+    cfg = SwimConfig(ping_timeout_ticks=16)
+    # track_latency=False: the latency trace is float-accumulated and
+    # run-to-run jittery in-process (pre-existing; unrelated to the
+    # ledger), so the bit-identity arms run without it.
+    st = init_state(n, seed=0, ring_contacts=n - 1, announced=True,
+                    track_latency=False)
+    sc = Scenario(n, ticks, seed=0)
+    for i, p in enumerate([5, 11, 17, 23]):
+        sc.kill_at(8 + 2 * i, [p])
+    return st, sc.build(), cfg
+
+
+def test_why_dense_histogram_parity():
+    from kaboodle_tpu.warp.runner import WarpLedger, simulate_warped
+
+    st, inputs, cfg = _churn_setup()
+    ledger = WarpLedger()
+    _, dense_ticks, _ = simulate_warped(
+        st, inputs, cfg, faulty=True, ledger=ledger
+    )
+    hist = ledger.blocked_histogram()
+    assert hist, "churn drain must leave dense spans to attribute"
+    # Exact parity: every dense tick is attributed to exactly one term.
+    assert sum(v["ticks"] for v in hist.values()) == int(dense_ticks.size)
+    assert sum(v["spans"] for v in hist.values()) == len(ledger.blocked)
+    # The attribution is meaningful: blocked terms name signature terms
+    # or the two pseudo-terms, never empty strings.
+    assert all(t for t in hist)
+
+
+def test_why_dense_ledger_is_observation_only():
+    from kaboodle_tpu.profiling import leaf_equal
+    from kaboodle_tpu.warp.runner import WarpLedger, simulate_warped
+
+    st, inputs, cfg = _churn_setup()
+    out_bare, ticks_bare, _ = simulate_warped(st, inputs, cfg, faulty=True)
+    out_led, ticks_led, _ = simulate_warped(
+        st, inputs, cfg, faulty=True, ledger=WarpLedger()
+    )
+    assert int(ticks_bare.size) == int(ticks_led.size)
+    assert all(
+        leaf_equal(a, b)
+        for a, b in zip(jax.tree.leaves(out_bare), jax.tree.leaves(out_led))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Telemetry schema: warp_blocked + costscope records round-trip.
+
+
+def test_manifest_roundtrip_new_kinds(tmp_path):
+    from kaboodle_tpu.telemetry.manifest import (
+        ManifestWriter,
+        read_manifest,
+        validate_record,
+    )
+
+    path = str(tmp_path / "m.jsonl")
+    with ManifestWriter(path) as w:
+        w.write("warp_blocked", term="fp_disagree+missing_alive", ticks=12,
+                spans=3, engine="sim", members=1)
+        w.write("costscope", entry="ops.crc32", flops=491, bytes_accessed=2714,
+                peak_bytes=1042, ici_bytes=0, sharded=False)
+    kinds = [r["kind"] for r in read_manifest(path, validate=True)]
+    assert kinds == ["warp_blocked", "costscope"]
+
+    with pytest.raises(ValueError):
+        validate_record(
+            {"schema": "kaboodle-telemetry/1", "kind": "warp_blocked",
+             "term": "", "ticks": 1, "spans": 1}
+        )
+    with pytest.raises(ValueError):
+        validate_record(
+            {"schema": "kaboodle-telemetry/1", "kind": "warp_blocked",
+             "term": "x", "ticks": "1", "spans": 1}
+        )
+    with pytest.raises(ValueError):
+        validate_record(
+            {"schema": "kaboodle-telemetry/1", "kind": "costscope"}
+        )

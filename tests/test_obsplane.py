@@ -415,6 +415,32 @@ def test_engine_binds_gauges_and_segments(tmp_path):
     engine.obs.close()
 
 
+def test_engine_exposes_why_dense_and_cache_gauges(tmp_path):
+    """The costscope pull-gauges (ISSUE 15): the why-dense histogram and
+    per-kind leap-cache hit rates surface through collect()/to_prometheus
+    with one bind() wiring — the ledger is host-side, read lazily."""
+    engine = ServeEngine([_pool(lanes=2)], warp=False,
+                         journal_dir=str(tmp_path / "j"), obs=True)
+    engine.warmup()
+    # The serve loop records into engine.warp_ledger on leap->chunk
+    # fallback; feed the ledger directly so the gauge read is pinned
+    # regardless of which rounds this toy workload happens to take.
+    engine.warp_ledger.record_blocked(None, 8, "serve")
+    snap = engine.obs.metrics.collect()
+    g = snap["gauges"]
+    assert g["warp_blocked_ticks"]["term=scheduled_event"] == 8.0
+    assert g["warp_blocked_spans"]["term=scheduled_event"] == 1.0
+    # per-kind hit rates mirror the shared leap cache's stats() map.
+    from kaboodle_tpu.warp.runner import leap_cache
+
+    per_kind = leap_cache.stats()["per_kind"]
+    rates = g.get("warp_leap_cache_hit_rate", {})
+    assert set(rates) == {f"kind={k}" for k in per_kind}
+    prom = engine.obs.metrics.to_prometheus()
+    assert 'warp_blocked_ticks{term="scheduled_event"} 8' in prom
+    engine.close()
+
+
 def test_recover_emits_spans_in_seq_order(tmp_path):
     """Crash recovery replays the journal and re-opens spans for requeued
     and spilled requests, ordered by journal seq."""

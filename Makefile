@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -12,20 +12,26 @@ test:
 test-quick:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-# graftlint + graftscan: the two-lane static gate. Line 1 is the
-# dependency-free JAX/TPU-aware AST lane — the clippy `-D warnings`
+# graftlint + graftscan + graftconc: the three-lane static gate. Line 1
+# is the dependency-free JAX/TPU-aware AST lane — the clippy `-D warnings`
 # analogue (reference main.yml:48-52), rules KB1xx/KB2xx/KB3xx, parse
 # speed. Line 2 is the IR lane (kaboodle_tpu/analysis/ir/): rules
 # KB401-KB405 over the TRACED kernel entry points — dtype widening under
 # x64, host callbacks, baked-in constants, GSPMD spec derivation, and the
 # compile-surface budget (.graftscan_surface.json) measured by a scripted
 # dense+warp+fleet exercise (~1 min on CPU, the only compile-heavy step).
-# `--no-baseline-growth` makes BOTH checked-in baselines monotonically
+# Line 3 is the concurrency lane (kaboodle_tpu/analysis/conc/): rules
+# KB501-KB506 over the serve plane's three execution contexts — event-loop
+# blocking, guarded_by lock discipline, device values crossing threads,
+# durable-write protocol, lock-order cycles, unbounded queues — same AST
+# machinery as line 1, its own debt file (.graftconc_baseline.json).
+# `--no-baseline-growth` makes ALL checked-in baselines monotonically
 # shrinking debt. See kaboodle_tpu/analysis/ (scripts/lint.py is a shim).
 lint:
 	$(PYTHON) -m kaboodle_tpu.analysis --no-baseline-growth
 	timeout 300 env JAX_PLATFORMS=cpu \
 	  $(PYTHON) -m kaboodle_tpu.analysis --ir --no-baseline-growth
+	$(PYTHON) -m kaboodle_tpu.analysis --conc --no-baseline-growth
 	$(PYTHON) scripts/license_check.py
 
 native:
@@ -56,6 +62,7 @@ sim:
 # whole ensemble stack (vmapped kernel -> masked converge loop -> on-device
 # stats -> table/JSON output) end-to-end at toy scale.
 ci: lint native test
+	$(MAKE) conc-dryrun
 	timeout 420 $(PYTHON) __graft_entry__.py
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 	$(MAKE) fleet-dryrun
@@ -186,6 +193,15 @@ costscope-dryrun:
 scan-dryrun:
 	timeout 300 env JAX_PLATFORMS=cpu \
 	  $(PYTHON) -m kaboodle_tpu.analysis --ir --no-baseline-growth
+
+# graftconc standalone (ISSUE 16): the concurrency gate — KB501-KB506 over
+# the serve scope against .graftconc_baseline.json, shrink-only debt. The
+# same invocation `make lint` line 3 runs; this target exists for
+# iterating on the conc lane itself (and is what `make ci` names). The
+# RUNTIME half (lock-order sanitizer + event-loop watchdog) rides inside
+# serve-chaos-dryrun and the serve/obsplane test suites.
+conc-dryrun:
+	$(PYTHON) -m kaboodle_tpu.analysis --conc --no-baseline-growth
 
 # Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
 # then the every-fault-path scan, N=8192 over 8 virtual CPU devices,

@@ -18,15 +18,23 @@ repo's jit/shard_map idioms. Rule families:
   (KB401), host callbacks in jitted programs (KB402), oversized captured
   constants (KB403), GSPMD spec derivation (KB404), and the
   compile-surface budget vs ``.graftscan_surface.json`` (KB405).
+- **KB5xx concurrency** (graftconc, ``analysis/conc/`` — the ``--conc``
+  lane, serve scope only): blocking calls reachable from the event loop
+  (KB501), ``# guarded_by:`` lock discipline (KB502), device values
+  crossing thread boundaries unmaterialized (KB503), the
+  flush->fsync->replace durable-write protocol (KB504), lock-order cycles
+  (KB505), unbounded queues (KB506) — plus the RUNTIME half,
+  ``conc/sanitizer.py``: dynamic lock-order graph + event-loop watchdog
+  under chaos and the serve test suites.
 
 Suppression: per-line ``# noqa: KBnnn`` (bare ``# noqa`` and foreign-code
 lists suppress everything on the line), or a justified entry in the
 checked-in baseline — ``.graftlint_baseline.json`` for the AST lane,
 ``.graftscan_baseline.json`` for IR findings (which have no source line to
-noqa) — see ``core.py``.
+noqa), ``.graftconc_baseline.json`` for the conc lane — see ``core.py``.
 
-CLI: ``python -m kaboodle_tpu.analysis [--ir] [--explain KBnnn]
-[paths...]``; ``make lint`` and CI run both lanes, and CI's
+CLI: ``python -m kaboodle_tpu.analysis [--ir|--conc] [--explain KBnnn]
+[paths...]``; ``make lint`` and CI run all three lanes, and CI's
 ``--no-baseline-growth`` steps guarantee every baseline only shrinks.
 
 The default lane imports no jax: analysis is pure AST, so it and its tests

@@ -3,7 +3,7 @@
 Exit codes: 0 clean (baselined findings allowed), 1 findings / baseline
 violations, 2 usage or baseline-format error.
 
-Two lanes share one UX:
+Three lanes share one UX:
 
 - **AST lane** (default): rules KB1xx-KB3xx over the source tree. Pure
   ``ast`` + stdlib — no jax, parse speed.
@@ -11,11 +11,18 @@ Two lanes share one UX:
   points (kaboodle_tpu/analysis/ir/) plus the compile-surface budget.
   Imports jax (CPU-pinned), so it is its own invocation — ``make lint``
   runs both lines.
+- **conc lane** (``--conc``, or the ``conc`` subcommand): rules
+  KB501-KB506 (kaboodle_tpu/analysis/conc/) — the host-concurrency
+  auditor for the serve plane. Same dependency-free AST machinery as the
+  default lane but a separate gate: its scope, findings, and debt file
+  (``.graftconc_baseline.json``) evolve independently of graftlint's.
+  ``make conc-dryrun`` / ``make lint`` line 3 run it.
 
-Modes (both lanes):
+Modes (all lanes):
 
 - default: report every finding whose key is not in the lane's baseline
-  (``.graftlint_baseline.json`` / ``.graftscan_baseline.json``).
+  (``.graftlint_baseline.json`` / ``.graftscan_baseline.json`` /
+  ``.graftconc_baseline.json``).
 - ``--no-baseline-growth``: additionally fail on *stale* baseline entries
   (keys that no longer match any finding) and, in the IR lane, on a
   compile-surface count below its committed budget. Together with the
@@ -42,13 +49,15 @@ DEFAULT_TARGETS = [
 ]
 
 DEFAULT_IR_BASELINE = ".graftscan_baseline.json"
+DEFAULT_CONC_BASELINE = ".graftconc_baseline.json"
 
 USAGE = """\
 usage: python -m kaboodle_tpu.analysis [options] [paths...]
 
 options:
-  --baseline PATH        baseline file (default: .graftlint_baseline.json,
-                         or .graftscan_baseline.json with --ir)
+  --baseline PATH        baseline file (default: .graftlint_baseline.json;
+                         .graftscan_baseline.json with --ir;
+                         .graftconc_baseline.json with --conc)
   --no-baseline          ignore the baseline entirely
   --no-baseline-growth   also fail on stale baseline entries (CI debt gate)
   --write-baseline       regenerate the baseline from current findings
@@ -56,6 +65,8 @@ options:
   --list-rules           print every rule id + title and exit
   --ir                   run the IR lane (graftscan, KB4xx) instead of the
                          AST lane; traces the kernel entry-point registry
+  --conc                 run the concurrency lane (graftconc, KB5xx) over
+                         the serve scope ('conc' as first arg works too)
   --entries a,b          (--ir) scan only the named entry points
   --surface PATH         (--ir) surface budget (default: .graftscan_surface.json)
   --write-surface        (--ir) regenerate the surface budget file
@@ -66,11 +77,16 @@ options:
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # `python -m kaboodle_tpu.analysis conc ...` == `... --conc ...`: the
+    # subcommand spelling matches the other kaboodle_tpu CLI planes.
+    if argv and argv[0] == "conc":
+        argv[0] = "--conc"
     baseline_path: pathlib.Path | None = None
     use_baseline = True
     no_growth = False
     write = False
     ir_mode = False
+    conc_mode = False
     entries_filter: list[str] | None = None
     surface_path: pathlib.Path | None = None
     write_surface = False
@@ -98,6 +114,8 @@ def main(argv: list[str] | None = None) -> int:
             write = True
         elif a == "--ir":
             ir_mode = True
+        elif a == "--conc":
+            conc_mode = True
         elif a == "--entries":
             i += 1
             if i >= len(argv):
@@ -134,6 +152,10 @@ def main(argv: list[str] | None = None) -> int:
             targets.append(a)
         i += 1
 
+    if ir_mode and conc_mode:
+        print("--ir and --conc are separate lanes; run them separately",
+              file=sys.stderr)
+        return 2
     if ir_mode:
         if targets:
             print(
@@ -153,13 +175,24 @@ def main(argv: list[str] | None = None) -> int:
             with_surface,
         )
 
+    # Lane split: the default AST lane runs KB1xx-KB3xx; --conc runs only
+    # the KB5xx rules (scope-gated to the serve plane) against its own
+    # baseline. One registry, two debt files.
+    lane = "graftconc" if conc_mode else "graftlint"
+    rules = [
+        core.REGISTRY[rid]
+        for rid in sorted(core.REGISTRY)
+        if rid.startswith("KB5") == conc_mode
+    ]
     files = core.iter_python_files(targets or DEFAULT_TARGETS)
     findings: list[core.Finding] = []
     for f in files:
-        findings.extend(core.analyze_path(f))
+        findings.extend(core.analyze_path(f, rules=rules))
 
     if baseline_path is None:
-        baseline_path = pathlib.Path(core.DEFAULT_BASELINE)
+        baseline_path = pathlib.Path(
+            DEFAULT_CONC_BASELINE if conc_mode else core.DEFAULT_BASELINE
+        )
     try:
         baseline = core.load_baseline(baseline_path) if use_baseline else {}
     except core.BaselineError as e:
@@ -169,7 +202,7 @@ def main(argv: list[str] | None = None) -> int:
     if write:
         core.write_baseline(baseline_path, findings, baseline)
         print(
-            f"graftlint: wrote {baseline_path} with "
+            f"{lane}: wrote {baseline_path} with "
             f"{len({x.key for x in findings})} entries",
             file=sys.stderr,
         )
@@ -190,7 +223,7 @@ def main(argv: list[str] | None = None) -> int:
             rc = 1
 
     print(
-        f"graftlint: {len(files)} files, {len(active)} findings"
+        f"{lane}: {len(files)} files, {len(active)} findings"
         + (f" ({suppressed} baselined)" if suppressed else ""),
         file=sys.stderr,
     )

@@ -1,0 +1,26 @@
+"""graftconc — the concurrency/effect analysis plane (KB5xx + sanitizer).
+
+Third analysis plane, alongside graftlint (KB1-3xx, single-threaded AST
+discipline) and graftscan/costscope (KB4xx, the traced device program).
+This one audits the HOST orchestration layer of the serve stack — the
+asyncio event loop, the spill writer thread, the WAL journal — for the
+bug classes neither of the other planes can see:
+
+- **Static half** (``rules.py``, rules KB501-KB506): a dependency-free
+  AST pass over the serve/spill/journal/admission/obsplane/server scope.
+  Runs behind ``python -m kaboodle_tpu.analysis --conc`` (or the ``conc``
+  subcommand) with its own shrink-only baseline
+  (``.graftconc_baseline.json``) and the usual ``# noqa: KB5nn`` /
+  ``--explain`` plumbing.
+- **Runtime half** (``sanitizer.py``): instrumented lock wrappers that
+  record the dynamic lock-acquisition-order graph and raise on the first
+  cycle-closing edge, plus an event-loop blocking-call detector (slow
+  callback threshold). Enabled inside the chaos harness and the serve
+  robustness/obsplane test suites, so every CI run doubles as a race
+  regression test.
+
+Like the rest of ``analysis/``, nothing here imports jax: the static
+rules are pure ``ast`` and the sanitizer is stdlib ``threading``/
+``asyncio`` only, so both halves load in any process (including the
+serve engine itself, which uses ``sanitizer.make_lock``).
+"""

@@ -10,8 +10,10 @@ without touching a backend.
 Vocabulary:
 
 - A **rule** has a stable id (``KB1xx`` generic, ``KB2xx`` jax-tracer,
-  ``KB3xx`` hot-path), a one-line title, and an ``--explain`` text that says
-  what it catches, why it matters on this codebase, and how to suppress it.
+  ``KB3xx`` hot-path, ``KB5xx`` concurrency — the graftconc lane, run via
+  ``--conc`` against its own ``.graftconc_baseline.json``), a one-line
+  title, and an ``--explain`` text that says what it catches, why it
+  matters on this codebase, and how to suppress it.
 - A **finding** is one diagnostic. Its ``key`` (path :: rule :: symbol —
   deliberately *no line number*, so baselines survive unrelated edits)
   is what the baseline file matches against.
@@ -191,15 +193,22 @@ def analyze_source(source: str, path: str = "module.py") -> list[Finding]:
     return analyze_module(Module(path, source))
 
 
-def analyze_path(path: pathlib.Path, display: str | None = None) -> list[Finding]:
-    """Findings for one file; unparseable source yields a single KB100."""
+def analyze_path(
+    path: pathlib.Path,
+    display: str | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    """Findings for one file; unparseable source yields a single KB100.
+
+    ``rules`` restricts the pass to one lane's rule set (the CLI's AST
+    lane excludes KB5xx; the --conc lane runs only KB5xx)."""
     _load_rules()
     name = display if display is not None else str(path)
     try:
         mod = Module(name, path.read_text())
     except SyntaxError as e:
         return [Finding(name, "KB100", e.lineno or 1, f"syntax error: {e.msg}", "<syntax>")]
-    return analyze_module(mod)
+    return analyze_module(mod, rules)
 
 
 def iter_python_files(targets: list[str]) -> list[pathlib.Path]:
@@ -214,13 +223,16 @@ def _load_rules() -> None:
     """Import the rule modules (idempotent) so REGISTRY is populated.
 
     rules_ir registers only the KB4xx documentation (no-op AST checks);
-    the passes themselves live in analysis/ir/ behind the --ir lane."""
+    the passes themselves live in analysis/ir/ behind the --ir lane.
+    conc.rules (KB5xx) register here too — --list-rules/--explain cover
+    every family — but the CLI runs them only in the --conc lane."""
     from kaboodle_tpu.analysis import (  # noqa: F401
         rules_generic,
         rules_hotpath,
         rules_ir,
         rules_jax,
     )
+    from kaboodle_tpu.analysis.conc import rules as rules_conc  # noqa: F401
 
 
 # ---------------------------------------------------------------------------

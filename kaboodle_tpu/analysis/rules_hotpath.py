@@ -58,6 +58,14 @@ HOT_DIRS = (
     # inside those bodies would time the sync, not the collective, and a
     # dtype drift changes the payload bytes the ring formulas attribute.
     "kaboodle_tpu/costscope/",
+    # analysis/conc/: the graftconc lane (ISSUE 16) is host-side AST + a
+    # runtime sanitizer, but the sanitizer's lock wrappers and loop
+    # watchdog run INSIDE the serve round loop under chaos/tests — an
+    # accidental host sync or dtype drift added there would be charged to
+    # the very latency numbers the sanitizer gates. KB301's reachability
+    # scoping keeps the untraced analyzer code quiet; this entry makes the
+    # dtype-discipline rules cover any traced surface it ever grows.
+    "kaboodle_tpu/analysis/conc/",
 )
 
 # Files whose tensors carry the int8/int16/int32/uint32 discipline the

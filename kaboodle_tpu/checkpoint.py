@@ -70,7 +70,9 @@ def _open_npz(path):
 def _savez_atomic(path, arrays: dict) -> None:
     """fsync-then-rename npz write: a reader (or a crash-recovery restore)
     sees either the complete previous file or the complete new one, never
-    a truncated archive."""
+    a truncated archive. This is graftconc KB504's canonical shape (with
+    journal._write_json_atomic): write tmp -> flush -> fsync -> os.replace,
+    every step present in THIS function so the rule can see them."""
     path = os.fspath(path)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:

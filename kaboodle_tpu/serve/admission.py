@@ -120,7 +120,7 @@ class AdmissionController:
         self._buckets[tenant] = b
         return b
 
-    def check_quota(self, tenant: str) -> None:
+    def check_quota(self, tenant: str) -> None:  # conc: event-loop
         """Take one token for ``tenant`` or raise :class:`QuotaError`."""
         b = self._bucket(tenant)
         if b is None or b.try_take():
@@ -129,7 +129,7 @@ class AdmissionController:
             f"tenant {tenant!r} over quota", retry_after_s=b.retry_after()
         )
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict:  # conc: event-loop
         """Point-in-time view for the metrics plane: queue bound plus the
         live token balance per tenant (refilled first, so the gauge reads
         what ``try_take`` would see). Only tenants that have actually
@@ -144,7 +144,7 @@ class AdmissionController:
             }
         return {"max_queue": self.max_queue, "tenants": tenants}
 
-    def check_queue(self, queued: int) -> None:
+    def check_queue(self, queued: int) -> None:  # conc: event-loop
         """Raise :class:`QueueFullError` when the queue is at capacity.
 
         The engine calls this AFTER trying to shed a lower-priority queued

@@ -70,7 +70,8 @@ def _leaves_equal(a, b) -> bool:
 
 
 def _percentile_ms(lat_s, q) -> float:
-    return float(np.percentile(np.asarray(lat_s, dtype=np.float64) * 1e3, q))
+    # Host-list percentile over a few hundred floats, not a device fetch.
+    return float(np.percentile(np.asarray(lat_s, dtype=np.float64) * 1e3, q))  # noqa: KB501
 
 
 # -- 1. spill latency: the async round loop never stalls on disk -----------
@@ -597,17 +598,28 @@ SCENARIOS = (
 
 
 def run_chaos_dryrun() -> int:
+    from kaboodle_tpu.analysis.conc import sanitizer
     from kaboodle_tpu.analysis.ir.surface import assert_counter_live
 
     assert_counter_live()
     report: dict = {"dryrun": "serve-chaos", "seed": CHAOS_SEED,
                     "scenarios": {}}
-    for name, fn in SCENARIOS:
-        t0 = time.perf_counter()
-        report["scenarios"][name] = fn()
-        report["scenarios"][name]["elapsed_s"] = round(
-            time.perf_counter() - t0, 2
-        )
+    # Every scenario runs under the runtime concurrency sanitizer: all
+    # SpillManager locks become order-recorded wrappers (an ABBA raises at
+    # the acquisition that closes the cycle, no deadlock interleaving
+    # needed) and the asyncio scenarios run loop-watchdogged — a chaos
+    # pass is also a race regression test. Threshold 1s: toy-scale rounds
+    # are sub-ms, warmup/recovery stalls are budgeted, so any trip is a
+    # real steady-state stall.
+    with sanitizer.enabled(loop_threshold_s=1.0):
+        for name, fn in SCENARIOS:
+            t0 = time.perf_counter()
+            report["scenarios"][name] = fn()
+            report["scenarios"][name]["elapsed_s"] = round(
+                time.perf_counter() - t0, 2
+            )
+        report["sanitizer"] = sanitizer.report()
+        sanitizer.assert_clean()
     report["ok"] = True
     print(json.dumps(report))
     return 0

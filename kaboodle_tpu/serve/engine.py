@@ -65,6 +65,7 @@ from collections import OrderedDict
 import jax.numpy as jnp
 import numpy as np
 
+from kaboodle_tpu.analysis.conc import sanitizer as _conc_sanitizer
 from kaboodle_tpu.errors import CheckpointError
 from kaboodle_tpu.serve.admission import AdmissionError
 from kaboodle_tpu.serve.obsplane import (
@@ -241,7 +242,7 @@ class ServeEngine:
             self._spiller = SpillManager(depth=self.spill_depth)
         return self._spiller
 
-    def close(self) -> None:
+    def close(self) -> None:  # conc: event-loop
         """Join outstanding spill I/O and release the journal handle."""
         if self._spiller is not None:
             self._spiller.flush()
@@ -282,7 +283,7 @@ class ServeEngine:
 
     # -- request surface ---------------------------------------------------
 
-    def submit(self, req: ServeRequest) -> int:
+    def submit(self, req: ServeRequest) -> int:  # conc: event-loop
         """Queue a request; returns its request id. Raises on an unserved
         N-class or a faulty knob no pool can honor — rejection is loud,
         not an event. With admission control attached, quota and
@@ -350,7 +351,7 @@ class ServeEngine:
         )
         self._span(rid, None, fate="shed")
 
-    def cancel(self, rid: int) -> bool:
+    def cancel(self, rid: int) -> bool:  # conc: event-loop
         """Cancel a request in any non-terminal state; frees its lane."""
         row = self._requests.get(rid)
         if row is None or row["state"] in (DONE, CANCELLED):
@@ -370,7 +371,7 @@ class ServeEngine:
         self._span(rid, None, fate="cancelled")
         return True
 
-    def status(self, rid: int | None = None):
+    def status(self, rid: int | None = None):  # conc: event-loop
         """One request's public row, or all of them (rid=None)."""
         if rid is not None:
             row = self._requests.get(rid)
@@ -533,7 +534,7 @@ class ServeEngine:
                 return
         raise RuntimeError("spill writes still failing after retries")
 
-    def restore(self, rid: int) -> bool:
+    def restore(self, rid: int) -> bool:  # conc: event-loop
         """Bring a spilled request back into a free lane (parked). Returns
         False when its class pool has no free lane right now. Prefers the
         spill manager's host cache (an evicted lane whose write has not
@@ -577,7 +578,7 @@ class ServeEngine:
                    fate="restored")
         return True
 
-    def resume(self, rid: int, mode: str = "ticks", ticks: int = 16) -> None:
+    def resume(self, rid: int, mode: str = "ticks", ticks: int = 16) -> None:  # conc: event-loop
         """Re-activate a parked request with a fresh budget (continuation
         runs across the park/spill boundary keep their tick counters)."""
         row = self._requests.get(rid)
@@ -617,6 +618,9 @@ class ServeEngine:
             raise ValueError("recover() needs an engine with journal_dir")
         if self._requests:
             raise ValueError("recover() needs an empty engine")
+        # Startup phase: replay + cold restores are budgeted stalls for
+        # the conc sanitizer's loop watchdog, like warmup's compiles.
+        _conc_sanitizer.budget_current_callback()
         table, next_rid = self.journal.replay()
         counts = {"done": 0, "spilled": 0, "requeued": 0, "cancelled": 0,
                   "dropped": 0}
@@ -693,7 +697,7 @@ class ServeEngine:
             for row in self._requests.values()
         )
 
-    def step(self) -> list[dict]:
+    def step(self) -> list[dict]:  # conc: event-loop
         """One engine round: fold spill completions, admit, advance every
         pool, harvest, spill. Never blocks on disk.
 
@@ -818,7 +822,9 @@ class ServeEngine:
         horizon = pool.active & ~pool.until_conv & (pool.remaining > 0)
         if not horizon.any():
             return False
-        rows = np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
+        rows = np.asarray(  # noqa: KB501 — bounded [E]-row fetch; the round loop dispatches inline by design (server.py docstring)
+            _fleet_signature(pool.cfg)(pool.mesh)
+        )
         # int32 on the host: jnp.asarray is then a plain device put — an
         # int64 vector would dispatch a fresh convert_element_type program
         # and break the zero-recompile contract.
@@ -967,16 +973,20 @@ class ServeEngine:
         everyone bit-exactly at zero). After this the round loop's
         admit/leap/chunk/harvest/spill path compiles nothing — the async
         spill's host copies are device fetches, not programs."""
-        for pool in self.pools.values():
-            pool.warmup()
-            if not self.warp or pool.faulty or pool.telemetry:
-                continue
-            np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
-            zeros = jnp.zeros((pool.lanes,), jnp.int32)
-            K = MIN_LEAP
-            while K <= self.max_leap:
-                pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, zeros)
-                K <<= 1
+        # Budgeted for the conc sanitizer's loop watchdog, like the
+        # compiles_steady gauge: warmup stalls are the contract, steady-
+        # state stalls are the bug.
+        with _conc_sanitizer.budgeted():
+            for pool in self.pools.values():
+                pool.warmup()
+                if not self.warp or pool.faulty or pool.telemetry:
+                    continue
+                np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
+                zeros = jnp.zeros((pool.lanes,), jnp.int32)
+                K = MIN_LEAP
+                while K <= self.max_leap:
+                    pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, zeros)
+                    K <<= 1
         self._emit_standalone(
             "serve_event", event="warm", request_id=-1, lane=-1,
             pool_n=min(self.pools), pools=sorted(self.pools),
@@ -1007,7 +1017,7 @@ class ServeEngine:
             self.on_event(rec)
         return rec
 
-    def stats(self) -> dict:
+    def stats(self) -> dict:  # conc: event-loop
         states: dict[str, int] = {}
         for row in self._requests.values():
             states[row["state"]] = states.get(row["state"], 0) + 1

@@ -94,7 +94,7 @@ class ServeJournal:
 
     # -- write side --------------------------------------------------------
 
-    def append(self, op: str, rid: int, **fields) -> None:
+    def append(self, op: str, rid: int, **fields) -> None:  # conc: event-loop
         rec = {
             "op": op,
             "rid": int(rid),
@@ -112,7 +112,7 @@ class ServeJournal:
     def should_compact(self) -> bool:
         return self._appends_since_compact >= self.compact_every
 
-    def compact(self, table: dict[int, dict], next_rid: int) -> None:
+    def compact(self, table: dict[int, dict], next_rid: int) -> None:  # conc: event-loop
         """Snapshot the folded table to ``manifest.json`` and truncate the
         WAL. The snapshot lands atomically BEFORE the WAL is cut, so a
         crash between the two replays some transitions twice into the same

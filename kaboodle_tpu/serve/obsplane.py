@@ -180,7 +180,7 @@ class MetricsRegistry:
             for labels, v in fn().items():
                 yield name, _label_key(dict(labels)), float(v)
 
-    def collect(self) -> dict:
+    def collect(self) -> dict:  # conc: event-loop
         """JSON-able snapshot (the ``metrics`` RPC payload)."""
         return {
             "counters": {
@@ -261,7 +261,7 @@ class RoundProfiler:
         self._t_round = time.perf_counter_ns()
         return self._t_round
 
-    def round_end(self) -> None:
+    def round_end(self) -> None:  # conc: event-loop
         self._acc[SEG_ROUND] = time.perf_counter_ns() - self._t_round
         np.floor_divide(self._acc, 1000, out=self.last_us)
         for i, h in enumerate(self.hist):

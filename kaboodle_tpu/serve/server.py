@@ -72,7 +72,7 @@ class _Subscriber:
         self.q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self.dropped = 0
 
-    def push(self, rec) -> None:
+    def push(self, rec) -> None:  # conc: event-loop
         if self.q.full():
             self.dropped += 1
             return
@@ -138,13 +138,13 @@ class ServeServer:
 
     # -- event fan-out -----------------------------------------------------
 
-    def _on_event(self, rec: dict) -> None:
+    def _on_event(self, rec: dict) -> None:  # conc: event-loop
         if self.manifest is not None:
             self.manifest.write_record(rec)
         for sub in self._subscribers:
             sub.push(rec)
 
-    def _resolve_waiters(self) -> None:
+    def _resolve_waiters(self) -> None:  # conc: event-loop
         for rid in list(self._waiters):
             row = self.engine.status(rid)
             if row is not None and _wait_done(row):

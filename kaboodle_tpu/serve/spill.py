@@ -35,6 +35,8 @@ import dataclasses
 import queue
 import threading
 
+from kaboodle_tpu.analysis.conc.sanitizer import make_lock
+
 
 @dataclasses.dataclass(frozen=True)
 class SpillResult:
@@ -58,10 +60,16 @@ class SpillManager:
 
     def __init__(self, depth: int = 4) -> None:
         self._work: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
-        self._done: queue.Queue = queue.Queue()
-        self._cache: dict[int, object] = {}
-        self._lock = threading.Lock()
-        self._fail_next = 0
+        # KB506 waiver: fed only by the bounded _work queue (one completion
+        # per submitted item) and drained to empty by the engine's
+        # _poll_spills at EVERY round start, so occupancy is bounded by
+        # depth + one round's completions.
+        self._done: queue.Queue = queue.Queue()  # noqa: KB506
+        self._cache: dict[int, object] = {}  # guarded_by: _lock
+        # Sanitized under the chaos/test harnesses (dynamic lock-order
+        # graph), a plain threading.Lock in production — see make_lock.
+        self._lock = make_lock("SpillManager._lock")
+        self._fail_next = 0  # guarded_by: _lock
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="kaboodle-spill-writer", daemon=True
@@ -70,7 +78,7 @@ class SpillManager:
 
     # -- engine-facing API (round-loop thread) -----------------------------
 
-    def submit_write(self, rid: int, path: str, member) -> bool:
+    def submit_write(self, rid: int, path: str, member) -> bool:  # conc: event-loop
         """Queue a durable write of ``member`` to ``path``. ``member`` is
         a state tree OR a zero-arg thunk producing one (the worker
         materializes it off the round loop). Returns False — try again
@@ -93,7 +101,7 @@ class SpillManager:
             return False
         return True
 
-    def poll(self) -> list[SpillResult]:
+    def poll(self) -> list[SpillResult]:  # conc: event-loop
         """Drain completed background I/Os (non-blocking)."""
         out: list[SpillResult] = []
         while True:

@@ -132,10 +132,13 @@ class ManifestWriter:
     def write(self, kind: str = "run", **fields) -> dict:
         return self.write_record(run_record(kind, **fields))
 
-    def write_record(self, rec: dict) -> dict:
+    def write_record(self, rec: dict) -> dict:  # conc: event-loop
         """Validate and write an ALREADY-BUILT record (the serve engine
         emits records through ``on_event`` fan-out; the server writes the
-        same dict it hands to stream subscribers)."""
+        same dict it hands to stream subscribers — i.e. this runs ON the
+        event loop, which is why this file is in graftconc's CONC_SCOPE:
+        the write/flush here must stay a buffered line append, never an
+        fsync or a device fetch)."""
         rec = validate_record(rec)
         self._f.write(json.dumps(rec) + "\n")
         self.records_written += 1

@@ -1,0 +1,664 @@
+"""graftconc (ISSUE 16): KB5xx rule fixtures, pragmas, CLI lane, sanitizer.
+
+Mirrors tests/test_analysis.py's structure for the concurrency lane: every
+KB5xx rule gets positive and negative fixtures at in-scope paths, the
+pragma grammar (`# conc: event-loop`, `# guarded_by:`, `# noqa: KB5nn`)
+is exercised edge-on, the `--conc` CLI lane round-trips its own baseline,
+and three seeded mutations of the REAL serve sources prove the gate turns
+red for the bug classes it exists to catch. The runtime sanitizer half
+(lock-order graph + loop watchdog) is pinned in isolation here; its
+integration runs live under tests/test_serve_robustness.py and the chaos
+harness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from kaboodle_tpu.analysis import analyze_source
+from kaboodle_tpu.analysis.cli import main
+from kaboodle_tpu.analysis.core import REGISTRY, _load_rules
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# In-scope by default: KB5xx rules only fire on the serve concurrency
+# surface (CONC_SCOPE), so fixtures opt in via their path.
+SERVE = "kaboodle_tpu/serve/fixture.py"
+
+
+def conc_of(src: str, path: str = SERVE) -> list[str]:
+    """KB5xx rule ids firing on a dedented fixture at an in-scope path
+    (non-conc families are filtered out: shared registry, separate lane)."""
+    return [
+        f.rule
+        for f in analyze_source(textwrap.dedent(src), path)
+        if f.rule.startswith("KB5")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# KB501 — blocking calls on the event loop
+
+
+def test_kb501_blocking_in_async_def():
+    assert "KB501" in conc_of(
+        """
+        import time
+        async def handler():
+            time.sleep(1)
+        """
+    )
+    # awaiting the async sleep is the fix
+    assert "KB501" not in conc_of(
+        """
+        import asyncio
+        async def handler():
+            await asyncio.sleep(1)
+        """
+    )
+
+
+def test_kb501_lock_acquire_and_open_are_blocking():
+    assert "KB501" in conc_of(
+        """
+        async def handler(self):
+            self._lock.acquire()
+        """
+    )
+    assert "KB501" in conc_of(
+        """
+        async def handler(path):
+            with open(path) as f:
+                return f.read()
+        """
+    )
+
+
+def test_kb501_interprocedural_reach():
+    # the blocking call hides one module-local hop away from the seed
+    assert "KB501" in conc_of(
+        """
+        import os
+        def _flush_to_disk(fd):
+            os.fsync(fd)
+        async def handler(fd):
+            _flush_to_disk(fd)
+        """
+    )
+
+
+def test_kb501_event_loop_pragma_seeds_sync_def():
+    # `# conc: event-loop` marks functions the loop calls cross-module
+    # (ServeEngine.step from the asyncio server) — same closure as async def
+    src = """
+        import time
+        def step(self):{pragma}
+            time.sleep(0.1)
+        """
+    assert "KB501" in conc_of(src.format(pragma="  # conc: event-loop"))
+    assert "KB501" not in conc_of(src.format(pragma=""))
+
+
+def test_kb501_executor_offload_is_exempt():
+    # the offload ARGUMENT runs off-loop by construction: time.sleep is
+    # handed as a function object, never called on the loop
+    assert "KB501" not in conc_of(
+        """
+        import asyncio, time
+        async def handler():
+            await asyncio.to_thread(time.sleep, 1)
+        """
+    )
+
+
+def test_conc_scope_gating():
+    src = """
+        import time
+        async def handler():
+            time.sleep(1)
+        """
+    assert "KB501" in conc_of(src, path="kaboodle_tpu/serve/server.py")
+    # outside CONC_SCOPE the whole family is silent
+    assert conc_of(src, path="kaboodle_tpu/swim/kernels.py") == []
+    assert conc_of(src, path="module.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KB502 — guarded_by lock discipline
+
+
+def test_kb502_unguarded_access_fires():
+    src = """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}  # guarded_by: _lock
+            def good(self):
+                with self._lock:
+                    self._cache[1] = 2
+            def bad(self):
+                return self._cache
+        """
+    assert conc_of(src).count("KB502") == 1  # bad() only; good() holds it
+
+
+def test_kb502_init_is_exempt():
+    # construction is single-threaded and the lock may not exist yet when
+    # the guarded field is first assigned
+    assert "KB502" not in conc_of(
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._cache = {}  # guarded_by: _lock
+                self._lock = threading.Lock()
+            def get(self):
+                with self._lock:
+                    return self._cache
+        """
+    )
+
+
+def test_kb502_guarded_def_on_property():
+    src = """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by: _lock
+            @property
+            def n(self):  # guarded_by: _lock
+                return self._n
+            def peek(self):
+                return self.n{suffix}
+        """
+    # the def pragma asserts the lock at entry: the property body passes,
+    # but a lock-less access site is the violation
+    bad = textwrap.dedent(src).format(suffix="")
+    assert "KB502" in conc_of(bad)
+    good = textwrap.dedent(
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by: _lock
+            @property
+            def n(self):  # guarded_by: _lock
+                return self._n
+            def peek(self):
+                with self._lock:
+                    return self.n
+        """
+    )
+    assert "KB502" not in conc_of(good)
+
+
+def test_kb502_helper_inferred_lock_held():
+    # a private helper whose EVERY intra-class call site holds the lock is
+    # lock-held inside too — no pragma needed
+    assert "KB502" not in conc_of(
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}  # guarded_by: _lock
+            def outer(self):
+                with self._lock:
+                    self._evict()
+            def _evict(self):
+                self._cache.clear()
+        """
+    )
+
+
+# ---------------------------------------------------------------------------
+# KB503 — device values crossing thread boundaries
+
+
+def test_kb503_device_value_into_queue():
+    assert "KB503" in conc_of(
+        """
+        import jax.numpy as jnp
+        def producer(q):
+            dev = jnp.zeros((4,))
+            q.put(dev)
+        """
+    )
+
+
+def test_kb503_materialization_cuts_taint():
+    assert "KB503" not in conc_of(
+        """
+        import jax.numpy as jnp
+        import numpy as np
+        def producer(q):
+            dev = jnp.zeros((4,))
+            q.put(np.asarray(dev))
+        """
+    )
+    assert "KB503" not in conc_of(
+        """
+        import jax.numpy as jnp
+        def producer(q):
+            dev = jnp.zeros(())
+            q.put(dev.item())
+        """
+    )
+
+
+def test_kb503_thread_args():
+    assert "KB503" in conc_of(
+        """
+        import threading
+        import jax.numpy as jnp
+        def spawn():
+            x = jnp.ones((2,))
+            t = threading.Thread(target=print, args=(x,))
+            t.start()
+        """
+    )
+
+
+# ---------------------------------------------------------------------------
+# KB504 — durable-write protocol
+
+
+def test_kb504_replace_without_fsync():
+    assert "KB504" in conc_of(
+        """
+        import os
+        def publish(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        """
+    )
+
+
+def test_kb504_full_protocol_is_clean():
+    assert "KB504" not in conc_of(
+        """
+        import os
+        def publish(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        """
+    )
+
+
+def test_kb504_serve_checkpoint_save_needs_atomic():
+    src = """
+        from kaboodle_tpu import checkpoint
+        def spill(tree, path):
+            checkpoint.save(tree, path{kw})
+        """
+    assert "KB504" in conc_of(src.format(kw=""))
+    assert "KB504" not in conc_of(src.format(kw=", atomic=True"))
+    # the atomic arm is serve/-only: checkpoint.py itself IMPLEMENTS save
+    assert "KB504" not in conc_of(
+        src.format(kw=""), path="kaboodle_tpu/checkpoint.py"
+    )
+
+
+# ---------------------------------------------------------------------------
+# KB505 — static lock-order graph
+
+
+def test_kb505_abba_cycle():
+    src = """
+        def one():
+            with _a:
+                with _b:
+                    pass
+        def two():
+            with {x}:
+                with {y}:
+                    pass
+        """
+    assert "KB505" in conc_of(src.format(x="_b", y="_a"))
+    assert "KB505" not in conc_of(src.format(x="_a", y="_b"))  # same order
+
+
+def test_kb505_cycle_through_call_under_lock():
+    # alpha holds _x and calls a helper that takes _y (edge x->y); beta
+    # nests y->x directly — cycle only visible interprocedurally
+    assert "KB505" in conc_of(
+        """
+        class C:
+            def alpha(self):
+                with self._x:
+                    self._grab_y()
+            def _grab_y(self):
+                with self._y:
+                    pass
+            def beta(self):
+                with self._y:
+                    with self._x:
+                        pass
+        """
+    )
+
+
+# ---------------------------------------------------------------------------
+# KB506 — unbounded queues
+
+
+def test_kb506_unbounded_ctors():
+    assert "KB506" in conc_of("import queue\nq = queue.Queue()\n")
+    assert "KB506" in conc_of("import asyncio\nq = asyncio.Queue()\n")
+    assert "KB506" in conc_of("import collections\nd = collections.deque()\n")
+    # SimpleQueue cannot be bounded at all
+    assert "KB506" in conc_of("import queue\nq = queue.SimpleQueue()\n")
+
+
+def test_kb506_bounded_ctors_are_clean():
+    assert "KB506" not in conc_of("import queue\nq = queue.Queue(maxsize=8)\n")
+    assert "KB506" not in conc_of(
+        "import collections\nd = collections.deque([], 64)\n"
+    )
+    assert "KB506" not in conc_of(
+        "import collections\nd = collections.deque(maxlen=64)\n"
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression + CLI lane
+
+
+def test_noqa_kb5_scoping():
+    assert "KB506" not in conc_of("import queue\nq = queue.Queue()  # noqa: KB506\n")
+    # a foreign code doesn't suppress
+    assert "KB506" in conc_of("import queue\nq = queue.Queue()  # noqa: KB501\n")
+    # bare noqa is blanket
+    assert "KB506" not in conc_of("import queue\nq = queue.Queue()  # noqa\n")
+
+
+def _write_mixed_fixture(tmp_path) -> pathlib.Path:
+    """A file with one default-lane finding (KB102 unused import) and one
+    conc-lane finding (KB506) at an in-scope path."""
+    d = tmp_path / "kaboodle_tpu" / "serve"
+    d.mkdir(parents=True)
+    p = d / "m.py"
+    p.write_text("import os\nimport queue\nq = queue.Queue()\n")
+    return p
+
+
+def test_cli_lane_separation(tmp_path, monkeypatch, capsys):
+    _write_mixed_fixture(tmp_path)
+    monkeypatch.chdir(tmp_path)
+
+    assert main(["--conc", "--no-baseline", "kaboodle_tpu"]) == 1
+    cap = capsys.readouterr()
+    assert "KB506" in cap.out and "KB102" not in cap.out
+    assert "graftconc:" in cap.err  # the lane announces itself (summary)
+
+    assert main(["--no-baseline", "kaboodle_tpu"]) == 1
+    cap = capsys.readouterr()
+    assert "KB102" in cap.out and "KB506" not in cap.out
+    assert "graftlint:" in cap.err
+
+
+def test_cli_conc_subcommand_alias(tmp_path, monkeypatch, capsys):
+    _write_mixed_fixture(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    assert main(["conc", "--no-baseline", "kaboodle_tpu"]) == 1
+    assert "KB506" in capsys.readouterr().out
+
+
+def test_conc_baseline_roundtrip(tmp_path, monkeypatch, capsys):
+    _write_mixed_fixture(tmp_path)
+    monkeypatch.chdir(tmp_path)
+
+    assert main(["--conc", "kaboodle_tpu"]) == 1
+    assert main(["--conc", "--write-baseline", "kaboodle_tpu"]) == 0
+    assert (tmp_path / ".graftconc_baseline.json").exists()
+    # the default-lane baseline is untouched: separate debt files
+    assert not (tmp_path / ".graftlint_baseline.json").exists()
+    assert main(["--conc", "kaboodle_tpu"]) == 0
+    assert main(["--conc", "--no-baseline", "kaboodle_tpu"]) == 1
+    capsys.readouterr()
+
+    # shrink-only: stale entries fail the growth gate, not the plain run
+    bl = tmp_path / ".graftconc_baseline.json"
+    data = json.loads(bl.read_text())
+    data["entries"].append(
+        {"key": "gone.py::KB506::Queue", "reason": "stale"}
+    )
+    bl.write_text(json.dumps(data))
+    assert main(["--conc", "kaboodle_tpu"]) == 0
+    assert main(["--conc", "--no-baseline-growth", "kaboodle_tpu"]) == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+
+
+def test_conc_baseline_requires_justification(tmp_path, monkeypatch):
+    _write_mixed_fixture(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".graftconc_baseline.json").write_text(
+        json.dumps({"entries": [{"key": "a.py::KB506::Queue"}]})
+    )
+    assert main(["--conc", "kaboodle_tpu"]) == 2
+
+
+def test_cli_explain_and_list_cover_kb5():
+    _load_rules()
+    for rid in ("KB501", "KB502", "KB503", "KB504", "KB505", "KB506"):
+        assert rid in REGISTRY
+        assert REGISTRY[rid].explain.strip()
+    assert main(["--explain", "KB505"]) == 0
+    assert main(["--list-rules"]) == 0
+
+
+def test_repo_is_conc_clean_under_baseline(monkeypatch):
+    """The acceptance gate, conc lane: HEAD's serve scope is clean (every
+    baselined stall individually justified, baseline not stale)."""
+    monkeypatch.chdir(REPO)
+    assert main(["--conc", "--no-baseline-growth"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations of the REAL serve sources: the gate must turn red
+
+
+def _copy_serve(tmp_path, *names) -> pathlib.Path:
+    """Copy real serve modules into a bare tmp tree (no __init__.py, so
+    the real installed package still wins the import path in subprocesses)."""
+    dst = tmp_path / "kaboodle_tpu" / "serve"
+    dst.mkdir(parents=True)
+    for n in names:
+        (dst / n).write_text(
+            (REPO / "kaboodle_tpu" / "serve" / n).read_text()
+        )
+    return dst
+
+
+MUTANT_ABBA = '''
+
+class _MutantInversion:
+    """Seeded KB505: the writer path and the poll path disagree on order."""
+
+    def writer_side(self):
+        with self._lock:
+            with self._io_lock:
+                pass
+
+    def poll_side(self):
+        with self._io_lock:
+            with self._lock:
+                pass
+'''
+
+MUTANT_DEVICE = '''
+
+import jax.numpy as _mjnp
+
+
+def _mutant_handoff(q):
+    """Seeded KB503: device handle crosses into the writer thread."""
+    dev = _mjnp.zeros((4,), _mjnp.int32)
+    q.put(dev)
+'''
+
+
+def test_seeded_lock_order_inversion_turns_gate_red(tmp_path, monkeypatch, capsys):
+    d = _copy_serve(tmp_path, "spill.py")
+    monkeypatch.chdir(tmp_path)
+    assert main(["--conc", "--no-baseline", "kaboodle_tpu"]) == 0  # pristine
+    with open(d / "spill.py", "a") as f:
+        f.write(MUTANT_ABBA)
+    capsys.readouterr()
+    assert main(["--conc", "--no-baseline", "kaboodle_tpu"]) == 1
+    assert "KB505" in capsys.readouterr().out
+
+
+def test_seeded_device_handoff_turns_gate_red(tmp_path, monkeypatch, capsys):
+    d = _copy_serve(tmp_path, "spill.py")
+    monkeypatch.chdir(tmp_path)
+    with open(d / "spill.py", "a") as f:
+        f.write(MUTANT_DEVICE)
+    assert main(["--conc", "--no-baseline", "kaboodle_tpu"]) == 1
+    assert "KB503" in capsys.readouterr().out
+
+
+def test_seeded_fsync_on_round_loop_turns_gate_red(tmp_path, monkeypatch, capsys):
+    d = _copy_serve(tmp_path, "engine.py")
+    # engine.py carries justified baselined stalls: run against the repo's
+    # committed baseline (absent modules' entries are stale, which the
+    # plain mode tolerates) so ONLY the mutation is new.
+    (tmp_path / ".graftconc_baseline.json").write_text(
+        (REPO / ".graftconc_baseline.json").read_text()
+    )
+    monkeypatch.chdir(tmp_path)
+    assert main(["--conc", "kaboodle_tpu"]) == 0  # pristine
+    src = (d / "engine.py").read_text()
+    marker = "def step(self) -> list[dict]:  # conc: event-loop\n"
+    assert marker in src
+    (d / "engine.py").write_text(
+        src.replace(marker, marker + "        os.fsync(0)\n", 1)
+    )
+    capsys.readouterr()
+    assert main(["--conc", "kaboodle_tpu"]) == 1
+    out = capsys.readouterr().out
+    assert "KB501" in out and "step" in out
+
+
+def test_seeded_mutation_red_via_module_entrypoint(tmp_path):
+    # the exact invocation CI runs: python -m kaboodle_tpu.analysis --conc
+    d = _copy_serve(tmp_path, "spill.py")
+    with open(d / "spill.py", "a") as f:
+        f.write(MUTANT_ABBA)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kaboodle_tpu.analysis", "--conc",
+         "--no-baseline", "kaboodle_tpu"],
+        cwd=tmp_path, capture_output=True, text=True,
+        env={**__import__("os").environ, "PYTHONPATH": str(REPO)},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KB505" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+
+
+def test_sanitizer_abba_raises_deterministically():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    with sanitizer.enabled():
+        a = sanitizer.make_lock("A")
+        b = sanitizer.make_lock("B")
+        with a:
+            with b:
+                pass
+        # ONE thread exercising the reverse order is enough: no deadlock
+        # interleaving required
+        with pytest.raises(sanitizer.LockOrderError, match="cycle"):
+            with b:
+                with a:
+                    pass
+
+
+def test_sanitizer_consistent_order_records_graph():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    with sanitizer.enabled():
+        a = sanitizer.make_lock("A")
+        b = sanitizer.make_lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert sanitizer.lock_graph() == {"A": ["B"]}
+        rep = sanitizer.report()
+        assert rep["locks"] == ["A", "B"]
+        assert rep["order_edges"] == 1
+        assert rep["loop_violations"] == []
+        sanitizer.assert_clean()
+
+
+def test_sanitizer_same_thread_reacquire_raises():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    with sanitizer.enabled():
+        a = sanitizer.make_lock("L")
+        with a:
+            with pytest.raises(sanitizer.LockOrderError, match="re-acquiring"):
+                a.acquire()
+
+
+def test_sanitizer_disabled_hands_out_plain_locks():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    assert not sanitizer.is_enabled()
+    lk = sanitizer.make_lock("X")
+    assert isinstance(lk, type(threading.Lock()))
+    assert not isinstance(lk, sanitizer.SanitizedLock)
+
+
+def test_sanitizer_loop_watchdog_flags_blocking_callback():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    async def _main():
+        asyncio.get_running_loop().call_soon(time.sleep, 0.1)
+        await asyncio.sleep(0.15)
+
+    with sanitizer.enabled(loop_threshold_s=0.02):
+        asyncio.run(_main())
+        v = sanitizer.loop_violations()
+        assert v and max(dt for _cb, dt in v) >= 0.02
+        with pytest.raises(AssertionError, match="event loop blocked"):
+            sanitizer.assert_clean()
+
+
+def test_sanitizer_budgeted_callback_is_excused():
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    def _warmup_like():
+        # the engine's warmup/recover pattern: a budgeted startup stall
+        sanitizer.budget_current_callback()
+        time.sleep(0.1)
+
+    async def _main():
+        asyncio.get_running_loop().call_soon(_warmup_like)
+        await asyncio.sleep(0.15)
+
+    with sanitizer.enabled(loop_threshold_s=0.02):
+        asyncio.run(_main())
+        assert sanitizer.loop_violations() == []
+        sanitizer.assert_clean()

@@ -33,6 +33,18 @@ CFG = SwimConfig(deterministic=True)
 N = 16  # shares test_serve.py's compiled set within the pytest process
 
 
+@pytest.fixture(autouse=True)
+def _conc_sanitizer():
+    """Obsplane tests run sanitized too: the observability plane must not
+    add locks in inconsistent order or block the loop (same bit-exactness
+    spirit as the obs-on/off contract, applied to concurrency)."""
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    with sanitizer.enabled(loop_threshold_s=2.0):
+        yield
+        sanitizer.assert_clean()
+
+
 def _pool(lanes: int = 3, **kw) -> LanePool:
     return LanePool(N, lanes, cfg=CFG, chunk=4, **kw)
 

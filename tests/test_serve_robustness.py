@@ -26,6 +26,20 @@ CFG = SwimConfig(deterministic=True)
 N = 16
 
 
+@pytest.fixture(autouse=True)
+def _conc_sanitizer():
+    """Every test in this module runs under the runtime concurrency
+    sanitizer: SpillManager locks become order-recorded wrappers (an ABBA
+    raises deterministically) and asyncio callbacks are watchdogged.
+    Threshold 2s — warmup/recovery are budgeted, CPU-backend rounds are
+    sub-ms, so a trip is a genuine event-loop stall."""
+    from kaboodle_tpu.analysis.conc import sanitizer
+
+    with sanitizer.enabled(loop_threshold_s=2.0):
+        yield
+        sanitizer.assert_clean()
+
+
 def _pool(lanes: int = 2, **kw) -> LanePool:
     return LanePool(N, lanes, cfg=CFG, chunk=8, **kw)
 

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -74,6 +74,7 @@ ci: lint native test
 	$(MAKE) serve-chaos-dryrun
 	$(MAKE) serve-obs-dryrun
 	$(MAKE) costscope-dryrun
+	$(MAKE) fedserve-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -185,6 +186,24 @@ costscope-dryrun:
 	timeout 120 $(PYTHON) -m kaboodle_tpu costscope --report
 	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu costscope \
 	  --icibench --dryrun
+
+# Fedserve dryrun (federation tier, ISSUE 17): two ServeEngines — one with
+# a ShardedLanePool on a 2x2 virtual-device mesh (hence the forced 8-device
+# CPU host platform, same as tests/conftest.py) — behind a FedRouter doing
+# consistent-hash + N-class-aware placement over shared per-engine-id
+# spill/journal roots. The dryrun drives a mixed open-loop wave through the
+# router, then KILLS one engine mid-flight with a kept request spilled on
+# it, and asserts from the inside: zero requests lost (every submitted rid
+# resolves exactly once), exactly one failover, the dead engine's WAL
+# replayed and its spilled request ADOPTED + restored + resumed on the
+# survivor, and zero fresh compiles across the steady window (KB405
+# counter). The measured ≥10x SLO curves are banked separately by
+# `python -m kaboodle_tpu fed-load` (PERF.md "Federated serving",
+# BENCH_fedserve.json); CI only proves the failover contracts.
+fedserve-dryrun:
+	timeout 540 env JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m kaboodle_tpu fed-load --dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

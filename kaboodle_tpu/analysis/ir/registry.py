@@ -310,6 +310,32 @@ def _serve_reseed():
     )
 
 
+def _serve_step_sharded():
+    # The ShardedLanePool's chunk program (ISSUE 17): the serve step with
+    # its [E] lane carry pinned onto the fleet mesh every while_loop
+    # iteration. KB404 audits the derived GSPMD specs — the lane axis must
+    # split across the ensemble mesh axis, and the output placement must
+    # match the input's (the sharded pool's zero-recompile leg).
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.fleet.core import init_fleet
+    from kaboodle_tpu.fleet.sharding import make_fleet_mesh
+    from kaboodle_tpu.phasegraph.derive import make_sharded_serve_step
+
+    mesh = make_fleet_mesh(len(_devices()))
+    e = max(TRACE_E, len(_devices()))  # E must divide across the mesh
+    fleet = init_fleet(TRACE_N // 2, e)
+    fn = make_sharded_serve_step(_cfg(), 4, mesh)
+    lanes = (
+        jnp.ones((e,), bool),  # active
+        jnp.ones((e,), bool),  # until_conv
+        jnp.full((e,), 16, jnp.int32),  # remaining
+        jnp.zeros((e,), jnp.int32),  # ticks_run
+        jnp.full((e,), -1, jnp.int32),  # conv_tick
+    )
+    return fn, (fleet.mesh, fleet.drop_rate, *lanes)
+
+
 def _serve_leap():
     # The serve engine's warped-lane variant: the SAME masked per-member
     # hybrid leap family as phasegraph.leap.fleet, registered under the
@@ -468,6 +494,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     # warped-lane leap dispatch.
     EntryPoint("phasegraph.serve.step", _serve_step),
     EntryPoint("phasegraph.serve.step.telemetry", _serve_step_telemetry),
+    EntryPoint("phasegraph.serve.step.sharded", _serve_step_sharded, sharded=True),
     EntryPoint("serve.reseed", _serve_reseed),
     EntryPoint("serve.leap", _serve_leap),
     EntryPoint("phasegraph.tick.sharded", _tick_sharded, sharded=True),

@@ -82,32 +82,55 @@ def _savez_atomic(path, arrays: dict) -> None:
     os.replace(tmp, path)
 
 
-def save(path, state: MeshState, atomic: bool = False) -> None:
+def save(
+    path, state: MeshState, atomic: bool = False, owner: str | None = None
+) -> None:
     """Write ``state`` to ``path`` (.npz), host-fetching device arrays.
 
     Optional fields that are ``None`` (the memory-lean ``track_latency=False``
     / ``instant_identity=True`` states) are simply absent from the archive —
     never pickled as object arrays, which ``load`` could not read back.
     ``atomic=True`` writes through a same-directory temp file with
-    fsync-then-rename (the serve spill path's durability contract)."""
+    fsync-then-rename (the serve spill path's durability contract).
+    ``owner`` stamps a writer identity (a federation engine-id) into the
+    archive; ``load(expect_owner=...)`` refuses an alien engine's snapshot
+    — two engines sharing one spill root can never cross-restore a lane by
+    accident (an intentional failover handover passes the dead engine's id
+    as ``expect_owner``)."""
     arrays = {
         f.name: np.asarray(getattr(state, f.name))
         for f in dataclasses.fields(state)
         if getattr(state, f.name) is not None
     }
     arrays["__version__"] = np.int32(_FORMAT_VERSION)
+    if owner is not None:
+        arrays["__owner__"] = np.frombuffer(
+            owner.encode("utf-8"), dtype=np.uint8
+        )
     if atomic:
         _savez_atomic(path, arrays)
     else:
         np.savez(path, **arrays)
 
 
-def load(path, mesh=None) -> MeshState:
+def checkpoint_owner(path) -> str | None:
+    """The engine-id stamped into a checkpoint by ``save(owner=...)``, or
+    ``None`` for an unstamped (single-engine era) archive."""
+    with _open_npz(path) as z:
+        if "__owner__" not in z.files:
+            return None
+        return bytes(np.asarray(z["__owner__"])).decode("utf-8")
+
+
+def load(path, mesh=None, expect_owner: str | None = None) -> MeshState:
     """Read a checkpoint; with ``mesh`` set, place rows across its devices
     (the layout kaboodle_tpu.parallel.shard_state would give a fresh state).
     Optional fields absent from the archive restore as ``None``. All failure
     modes — missing / truncated / corrupt file, wrong marker, missing
-    entries — raise :class:`CheckpointError`."""
+    entries — raise :class:`CheckpointError`. ``expect_owner`` enforces the
+    writer-identity stamp (see :func:`save`): a mismatching OR missing
+    stamp raises — an unstamped file in a shared federation spill root is
+    as suspect as an alien one."""
     with _open_npz(path) as z:
         if "__version__" not in z.files:
             raise CheckpointError(
@@ -116,6 +139,18 @@ def load(path, mesh=None) -> MeshState:
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
             raise CheckpointError(f"unsupported checkpoint version {version}")
+        if expect_owner is not None:
+            if "__owner__" not in z.files:
+                raise CheckpointError(
+                    f"checkpoint has no owner stamp (expected "
+                    f"{expect_owner!r}): {path}"
+                )
+            got = bytes(np.asarray(z["__owner__"])).decode("utf-8")
+            if got != expect_owner:
+                raise CheckpointError(
+                    f"checkpoint owned by alien engine {got!r} (expected "
+                    f"{expect_owner!r}): {path}"
+                )
         fields = {f.name for f in dataclasses.fields(MeshState)}
         missing = fields - set(z.files) - _optional_fields()
         if missing:

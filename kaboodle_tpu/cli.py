@@ -447,6 +447,14 @@ def main(argv=None) -> int:
         from kaboodle_tpu.serve.loadgen import main as loadgen_main
 
         return loadgen_main(argv[1:])
+    if argv and argv[0] == "fed-load":
+        # Federation load + chaos driver (serve/federation/fedload.py):
+        # two engines + consistent-hash router on loopback, SLO levels at
+        # multiples of the serve baseline rate, kill-one-engine failover;
+        # banks BENCH_fedserve.json. ``--dryrun`` is the CI lane.
+        from kaboodle_tpu.serve.federation.fedload import main as fedload_main
+
+        return fedload_main(argv[1:])
     if argv and argv[0] == "costscope":
         # Compiler/hardware-plane observatory (costscope/cli.py): static
         # cost+memory extraction over the graftscan registry gated against

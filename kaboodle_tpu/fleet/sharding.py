@@ -148,24 +148,48 @@ def shard_fleet_inputs(
     return jax.device_put(inputs, _named(mesh, specs))
 
 
-def make_sharded_fleet_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
-    """Vmapped tick whose output carry is constrained back onto the mesh
-    layout — the fleet twin of ``parallel.mesh.make_sharded_tick`` (stable
-    per-tick partitioning under scan/while_loop)."""
-    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+def make_fleet_constrainer(mesh: Mesh):
+    """``stacked MeshState -> same state, pinned to the fleet layout``.
+
+    Specs are derived from the (traced) carry itself, so the optional
+    fields' presence always matches the tree structure (the same contract
+    as ``parallel.mesh.make_sharded_tick``). This is the one constraint
+    every sharded fleet program applies to its mesh carry/output — the
+    sharded tick, the sharded serve step and the sharded leap all pin
+    through here, so their layouts cannot drift apart (drift would hand
+    jit differently-sharded inputs next dispatch and mint a recompile)."""
     peers = PEER_AXIS in mesh.axis_names
 
-    def sharded_tick(st: MeshState, inp: TickInputs):
-        st, m = vtick(st, inp)
-        # Specs derived from the (traced) carry itself, so the optional
-        # fields' presence always matches the tree structure (the same
-        # contract as parallel.mesh.make_sharded_tick).
+    def constrain(st: MeshState) -> MeshState:
         specs = jax.tree.map(
             lambda s: _stacked(s, peers),
             state_specs(st),
             is_leaf=lambda x: isinstance(x, P),
         )
-        st = jax.tree.map(jax.lax.with_sharding_constraint, st, _named(mesh, specs))
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, st, _named(mesh, specs)
+        )
+
+    return constrain
+
+
+def fleet_vector_sharding(mesh: Mesh) -> NamedSharding:
+    """Placement for per-member ``[E]`` vectors (drop knobs, generation
+    counters): split along the ensemble axis, like ``drop_rate`` in
+    :func:`fleet_state_specs`."""
+    return NamedSharding(mesh, P(ENSEMBLE_AXIS))
+
+
+def make_sharded_fleet_tick(cfg: SwimConfig, mesh: Mesh, faulty: bool = True):
+    """Vmapped tick whose output carry is constrained back onto the mesh
+    layout — the fleet twin of ``parallel.mesh.make_sharded_tick`` (stable
+    per-tick partitioning under scan/while_loop)."""
+    vtick = make_fleet_tick_fn(cfg, faulty=faulty)
+    constrain = make_fleet_constrainer(mesh)
+
+    def sharded_tick(st: MeshState, inp: TickInputs):
+        st, m = vtick(st, inp)
+        st = constrain(st)
         return st, m
 
     return sharded_tick

@@ -185,7 +185,11 @@ _jtu.register_dataclass(
 
 
 def make_serve_step(
-    cfg: SwimConfig, chunk: int, faulty: bool = False, telemetry: bool = False
+    cfg: SwimConfig,
+    chunk: int,
+    faulty: bool = False,
+    telemetry: bool = False,
+    constrain: Callable | None = None,
 ) -> Callable:
     """The serving engine's resident program: a masked fleet converge chunk.
 
@@ -216,6 +220,12 @@ def make_serve_step(
     ``telemetry=True`` derives from the telemetry-plane fleet tick and
     accumulates each lane's exact ``ProtocolCounters`` over the ticks it
     actually advanced (frozen ticks contribute zero).
+
+    ``constrain`` (stacked mesh -> stacked mesh) pins the lane-pool carry
+    onto a device mesh after every tick — the GSPMD hook
+    :func:`make_sharded_serve_step` fills in. Applied at loop entry AND in
+    the body, so the while_loop carry holds ONE placement from iteration
+    zero (the ``make_sharded_tick`` stability argument, lane-pool shaped).
 
     Returns ``serve_step(mesh, drop_rate, active, until_conv, remaining,
     ticks_run, conv_tick) -> (mesh, ServeStepOut)``.
@@ -264,6 +274,8 @@ def make_serve_step(
             m = out.metrics if telemetry else out
             adv = ~done
             mesh = freeze_members(adv, mesh, new)
+            if constrain is not None:
+                mesh = constrain(mesh)
             ticks_run = jnp.where(adv, ticks_run + 1, ticks_run)
             remaining = jnp.where(adv, remaining - 1, remaining)
             messages = messages + jnp.where(adv, m.messages_delivered, 0)
@@ -278,6 +290,8 @@ def make_serve_step(
             done = done | (until_conv & conv_now) | (remaining <= 0)
             return mesh, remaining, ticks_run, conv_tick, done, messages, ctr, i + 1
 
+        if constrain is not None:
+            mesh = constrain(mesh)
         mesh, remaining, ticks_run, conv_tick, done, messages, ctr, _ = (
             jax.lax.while_loop(
                 cond,
@@ -292,6 +306,35 @@ def make_serve_step(
         )
 
     return serve_step
+
+
+def make_sharded_serve_step(
+    cfg: SwimConfig,
+    chunk: int,
+    mesh,
+    faulty: bool = False,
+    telemetry: bool = False,
+) -> Callable:
+    """The serve step with its lane-pool carry pinned onto a fleet mesh.
+
+    The GSPMD twin of :func:`make_serve_step` — same program, same
+    bit-exact per-lane semantics, but every while_loop iteration constrains
+    the stacked ``[E, ...]`` mesh back onto the device mesh's fleet layout
+    (``fleet.sharding.make_fleet_constrainer``): the ``[E]`` lane axis
+    splits across the ensemble mesh axis and, on a 2-D ``E x peers`` mesh,
+    each lane's ``[N]`` rows split across the peer axis. XLA then
+    partitions every iteration identically — lanes tick device-locally;
+    only the loop's ``any(~done)`` predicate (and, on 2-D, the per-member
+    row collectives) cross the ICI. The output mesh carries the same
+    placement it came in with, so round-over-round re-dispatch never hands
+    jit a fresh input sharding (the sharded pool's zero-recompile leg).
+    """
+    from kaboodle_tpu.fleet.sharding import make_fleet_constrainer
+
+    return make_serve_step(
+        cfg, chunk, faulty=faulty, telemetry=telemetry,
+        constrain=make_fleet_constrainer(mesh),
+    )
 
 
 def make_warp_leap(

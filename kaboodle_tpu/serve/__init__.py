@@ -12,6 +12,8 @@ Layers:
 
 - pool.py    — the lane pool: fixed-shape FleetState + per-lane generation
                counters, on-device admit/retire/re-seed, pow2 N-classes.
+- shardpool.py — the GSPMD twin: the same pool resident on a fleet device
+               mesh (lanes across chips; rows too on a 2-D mesh).
 - engine.py  — the resident step loop: the phasegraph serve step (masked
                converge chunks) composed with the per-member fleet warp
                (quiescent horizon-mode lanes fast-forward, hot lanes tick
@@ -22,9 +24,18 @@ Layers:
 - loadgen.py — closed+open-loop load driver (BENCH_serve.json).
 - dryrun.py  — the CI lane: in-process server, toy requests, schema-checked
                manifest, zero-fresh-compiles assertion.
+- federation/ — the multi-engine tier: consistent-hash router, shared
+               spill root, WAL failover, fed-load driver.
 """
 
 from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
 from kaboodle_tpu.serve.pool import LanePool, lane_n_class
+from kaboodle_tpu.serve.shardpool import ShardedLanePool
 
-__all__ = ["LanePool", "ServeEngine", "ServeRequest", "lane_n_class"]
+__all__ = [
+    "LanePool",
+    "ServeEngine",
+    "ServeRequest",
+    "ShardedLanePool",
+    "lane_n_class",
+]

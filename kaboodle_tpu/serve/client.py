@@ -8,19 +8,36 @@ work submitted after ``open_stream`` can never be missed).
 
 Failure surface: a server-side op error raises :class:`ServeError`
 carrying the structured ``kind`` (``queue_full`` / ``quota`` /
-``bad_request`` / ``checkpoint`` / ``internal``) and ``retry_after_s``
-when the server supplied one. Every op takes ``timeout=`` seconds
-(None = unbounded) and raises a clean :class:`TimeoutError` — after
-which THIS connection is desynchronized (a late response may still be in
-flight) and refuses further ops; open a fresh client. ``submit`` can
-retry ``queue_full``/``quota`` rejections with backoff honoring the
-server's retry-after.
+``bad_request`` / ``checkpoint`` / ``failover`` / ``internal``) and
+``retry_after_s`` when the server supplied one. Every op takes
+``timeout=`` seconds (None = unbounded) and raises a clean
+:class:`TimeoutError` — after which THIS connection is desynchronized (a
+late response may still be in flight) and refuses further ops; open a
+fresh client. ``submit`` can retry ``queue_full``/``quota`` rejections
+with backoff honoring the server's retry-after.
+
+Reconnect (``reconnect=True``): when the transport breaks mid-op — the
+server restarted, a federation router failed an engine over, a proxy
+dropped the connection — the client re-dials with exponential backoff
+and transparently re-sends the op, but ONLY for ops that are safe to
+repeat (``_IDEMPOTENT``: a re-sent ``wait`` just waits again, a re-sent
+``cancel`` reports already-cancelled). ``submit`` is never auto-resent:
+the break may have landed after the server admitted the request, and a
+blind re-send would run it twice. Request ids survive the reconnect
+(server-side state), so a ``wait`` parked across a restart resumes by
+rid on the fresh connection.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+
+# Ops safe to re-send on a fresh connection after a transport break:
+# re-executing any of these cannot double-run a simulation.
+_IDEMPOTENT = frozenset(
+    {"status", "wait", "stats", "metrics", "cancel", "restore", "resume"}
+)
 
 
 class ServeError(RuntimeError):
@@ -56,23 +73,70 @@ class ServeStream:
 class ServeClient:
     """Sequential JSON-over-TCP ops against a :class:`ServeServer`."""
 
-    def __init__(self, host: str, port: int, reader, writer) -> None:
+    def __init__(self, host: str, port: int, reader, writer,
+                 reconnect: bool = False, redial_max: int = 8,
+                 redial_backoff: float = 0.05) -> None:
         self.host = host
         self.port = port
         self._reader = reader
         self._writer = writer
         self._desynced = False
+        self.reconnect = bool(reconnect)
+        self.redial_max = int(redial_max)
+        self.redial_backoff = float(redial_backoff)
 
     @classmethod
-    async def connect(cls, host: str = "127.0.0.1", port: int = 7447):
+    async def connect(cls, host: str = "127.0.0.1", port: int = 7447,
+                      reconnect: bool = False, redial_max: int = 8,
+                      redial_backoff: float = 0.05):
         reader, writer = await asyncio.open_connection(host, port)
-        return cls(host, port, reader, writer)
+        return cls(host, port, reader, writer, reconnect=reconnect,
+                   redial_max=redial_max, redial_backoff=redial_backoff)
+
+    async def _redial(self) -> None:
+        """Re-establish the transport with exponential backoff (the
+        server may be mid-restart; each retry doubles the sleep). A
+        fresh connection has clean request/response pairing, so an
+        earlier timeout's desync is cleared."""
+        last: Exception = ConnectionError("redial")
+        for attempt in range(self.redial_max):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                self._desynced = False
+                return
+            except OSError as e:
+                last = e
+                await asyncio.sleep(self.redial_backoff * (2 ** attempt))
+        raise ConnectionError(
+            f"could not reconnect to {self.host}:{self.port} after "
+            f"{self.redial_max} attempts"
+        ) from last
 
     async def _rpc(self, timeout: float | None = None, **op) -> dict:
+        """One request/response op, with transparent reconnect for
+        idempotent ops when enabled. A break during a non-idempotent op
+        (``submit``) always surfaces — the server may have applied it."""
+        retriable = self.reconnect and op.get("op") in _IDEMPOTENT
+        while True:
+            try:
+                return await self._rpc_once(timeout=timeout, **op)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                if not retriable:
+                    raise
+                retriable = False  # one transparent repeat per op
+                await self._redial()
+
+    async def _rpc_once(self, timeout: float | None = None, **op) -> dict:
         if self._desynced:
-            raise ConnectionError(
-                "connection desynchronized by an earlier timeout; reconnect"
-            )
+            if self.reconnect:
+                await self._redial()
+            else:
+                raise ConnectionError(
+                    "connection desynchronized by an earlier timeout; "
+                    "reconnect"
+                )
         self._writer.write(json.dumps(op).encode() + b"\n")
         await self._writer.drain()
         try:
@@ -155,6 +219,18 @@ class ServeClient:
                      ticks: int = 16, timeout: float | None = None) -> None:
         await self._rpc(timeout=timeout, op="resume",
                         request_id=request_id, mode=mode, ticks=ticks)
+
+    async def adopt(self, n: int, spill_path: str,
+                    saved_run: dict | None = None,
+                    owner: str | None = None,
+                    timeout: float | None = None, **fields) -> int:
+        """Federation failover handover: hand a dead engine's spilled
+        request (file + frozen run counters + owner stamp) to this
+        engine. Returns the adopting engine's fresh request id."""
+        resp = await self._rpc(timeout=timeout, op="adopt", n=n,
+                               spill_path=spill_path, saved_run=saved_run,
+                               owner=owner, **fields)
+        return resp["request_id"]
 
     async def stats(self, timeout: float | None = None) -> dict:
         resp = await self._rpc(timeout=timeout, op="stats")

@@ -62,7 +62,6 @@ import os
 import time
 from collections import OrderedDict
 
-import jax.numpy as jnp
 import numpy as np
 
 from kaboodle_tpu.analysis.conc import sanitizer as _conc_sanitizer
@@ -85,8 +84,6 @@ from kaboodle_tpu.warp.runner import (
     MIN_LEAP,
     WarpLedger,
     _classify,
-    _fleet_signature,
-    _get_fleet_leap,
     _leap_budget,
 )
 
@@ -151,6 +148,7 @@ def _fresh_row(req: ServeRequest) -> dict:
         "idle_rounds": 0,
         "spill_path": None,
         "saved_run": None,
+        "spill_owner": None,  # engine-id stamped in the spill file
         "retry_spill": False,
     }
 
@@ -179,6 +177,7 @@ class ServeEngine:
         spill_depth: int = 4,
         spills_per_round: int = 1,
         obs=None,
+        engine_id: str | None = None,
     ) -> None:
         self.pools: dict[int, LanePool] = {}
         for pool in pools:
@@ -193,6 +192,14 @@ class ServeEngine:
             raise ValueError(f"need max_leap >= MIN_LEAP ({MIN_LEAP})")
         self.spill_after = spill_after
         self.spill_dir = spill_dir
+        # Federation identity: namespaces this engine's spill files under
+        # ``<spill_dir>/<engine_id>/`` and stamps every spill archive +
+        # journal directory, so engines sharing one spill root can never
+        # collide on paths or silently cross-restore a lane snapshot.
+        self.engine_id = engine_id
+        if engine_id is not None and spill_dir is not None:
+            self.spill_dir = os.path.join(spill_dir, engine_id)
+            os.makedirs(self.spill_dir, exist_ok=True)
         self.on_event = on_event
         self.admission = admission
         self.sync_spill = bool(sync_spill)
@@ -208,7 +215,9 @@ class ServeEngine:
         if journal_dir is not None:
             from kaboodle_tpu.serve.journal import ServeJournal
 
-            self.journal = ServeJournal(journal_dir)
+            if engine_id is not None:
+                journal_dir = os.path.join(journal_dir, engine_id)
+            self.journal = ServeJournal(journal_dir, owner=engine_id)
         self.round = 0
         self._next_rid = 0
         self._events: list[dict] = []
@@ -239,7 +248,9 @@ class ServeEngine:
         if self._spiller is None:
             from kaboodle_tpu.serve.spill import SpillManager
 
-            self._spiller = SpillManager(depth=self.spill_depth)
+            self._spiller = SpillManager(
+                depth=self.spill_depth, owner=self.engine_id
+            )
         return self._spiller
 
     def close(self) -> None:  # conc: event-loop
@@ -419,11 +430,12 @@ class ServeEngine:
         if self.sync_spill:
             from kaboodle_tpu import checkpoint
 
-            checkpoint.save(path, pool.member(lane), atomic=True)
+            checkpoint.save(path, pool.member(lane), atomic=True,
+                            owner=self.engine_id)
             pool.release(lane)
             del self._lane_owner[(row["pool"], lane)]
             row.update(state=SPILLED, lane=None, spill_path=path,
-                       saved_run=saved_run)
+                       saved_run=saved_run, spill_owner=self.engine_id)
             self._log("spilled", rid, path=path, saved_run=saved_run)
             self._emit("serve_event",
                        event="preempted" if evict else "spilled",
@@ -441,7 +453,8 @@ class ServeEngine:
                        pool_n=row["pool"], lane=lane)
             return False
         self._log("spill_begin", rid, path=path)
-        row.update(spill_path=path, saved_run=saved_run)
+        row.update(spill_path=path, saved_run=saved_run,
+                   spill_owner=self.engine_id)
         if evict:
             pool.release(lane)
             del self._lane_owner[(row["pool"], lane)]
@@ -554,8 +567,14 @@ class ServeEngine:
             self._spiller.cached(rid) if self._spiller is not None else None
         )
         if member is None:
+            # The stamp check: this engine's own files carry its id; an
+            # adopted request's file carries the DEAD engine's id, which
+            # rode in through adopt(). Anything else is an alien snapshot.
+            expect = row["spill_owner"] if self.engine_id is not None else None
             try:
-                member = checkpoint.load(row["spill_path"])
+                member = checkpoint.load(
+                    row["spill_path"], expect_owner=expect
+                )
             except CheckpointError as e:
                 self._emit_standalone(
                     "serve_event", event="restore_failed", request_id=rid,
@@ -597,6 +616,47 @@ class ServeEngine:
                    pool_n=row["pool"], lane=row["lane"], mode=mode,
                    ticks=int(ticks))
         self._span(rid, "running", pool_n=row["pool"], lane=row["lane"])
+
+    def adopt(
+        self,
+        req: ServeRequest,
+        spill_path: str,
+        saved_run: dict | None,
+        owner: str | None,
+    ) -> int:  # conc: event-loop
+        """Take over a dead engine's SPILLED request (federation failover).
+
+        The router replays the dead engine's journal, finds a non-terminal
+        request whose last durable state is a spill file in the shared
+        root, and hands (request, file, frozen run counters, dead engine's
+        id) to a survivor here. The request lands ``spilled`` under a
+        fresh local rid; a later :meth:`restore` loads the file expecting
+        the DEAD engine's owner stamp — the explicit handover is exactly
+        the intentional cross-engine restore the stamp guard exists to
+        separate from accidental ones. Journaled as one ``adopted`` record
+        (submitted + spilled folded), so the survivor's own recovery
+        re-attaches it like any native spill."""
+        if req.n_class not in self.pools:
+            raise ValueError(
+                f"no pool serves N-class {req.n_class} (adopt n={req.n})"
+            )
+        if not os.path.exists(spill_path):
+            raise CheckpointError(f"adopt: spill file missing: {spill_path}")
+        rid = self._next_rid
+        self._next_rid += 1
+        row = _fresh_row(req)
+        row.update(state=SPILLED, spill_path=spill_path, saved_run=saved_run,
+                   spill_owner=owner)
+        self._requests[rid] = row
+        self._log("adopted", rid, req=dataclasses.asdict(req),
+                  path=spill_path, saved_run=saved_run, owner=owner)
+        self._emit_standalone(
+            "serve_event", event="adopted", request_id=rid,
+            pool_n=row["pool"], lane=-1, path=spill_path,
+            prior_owner=owner if owner is not None else "",
+        )
+        self._span(rid, "spilled", pool_n=row["pool"])
+        return rid
 
     # -- crash recovery ----------------------------------------------------
 
@@ -642,10 +702,14 @@ class ServeEngine:
             if op in ("cancelled", "shed"):
                 row["state"] = CANCELLED
                 counts["cancelled"] += 1
-            elif spill_ok and op in ("spilled", "restored"):
+            elif spill_ok and op in ("spilled", "restored", "adopted"):
+                # Native spills restore under this engine's stamp; adopted
+                # ones keep the dead engine's (the journal remembers it).
+                owner = (jrow.get("spill_owner") if op == "adopted"
+                         else self.engine_id)
                 row.update(state=SPILLED, spill_path=jrow["spill_path"],
                            saved_run=jrow.get("saved_run"),
-                           result=jrow.get("result"))
+                           result=jrow.get("result"), spill_owner=owner)
                 counts["spilled"] += 1
             elif op == "harvested":
                 row.update(state=DONE, result=jrow.get("result"))
@@ -823,11 +887,11 @@ class ServeEngine:
         if not horizon.any():
             return False
         rows = np.asarray(  # noqa: KB501 — bounded [E]-row fetch; the round loop dispatches inline by design (server.py docstring)
-            _fleet_signature(pool.cfg)(pool.mesh)
+            pool.signature()
         )
-        # int32 on the host: jnp.asarray is then a plain device put — an
-        # int64 vector would dispatch a fresh convert_element_type program
-        # and break the zero-recompile contract.
+        # int32 on the host: the pool's leap hook then device-puts it as
+        # is — an int64 vector would dispatch a fresh convert_element_type
+        # program and break the zero-recompile contract.
         k_m = np.zeros((pool.lanes,), dtype=np.int32)
         tracing = self.obs is not None and self.obs.trace
         classes: list[dict] = []
@@ -859,7 +923,7 @@ class ServeEngine:
         K = max(K, MIN_LEAP)
         if tracing:
             t0_us = self.obs.now_us()
-        pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, jnp.asarray(k_m))
+        pool.leap(K, k_m)
         pool.advance_leaped(k_m)
         self._emit(
             "serve_round", round=self.round, pool_n=pool.n, engine="leap",
@@ -981,11 +1045,11 @@ class ServeEngine:
                 pool.warmup()
                 if not self.warp or pool.faulty or pool.telemetry:
                     continue
-                np.asarray(_fleet_signature(pool.cfg)(pool.mesh))
-                zeros = jnp.zeros((pool.lanes,), jnp.int32)
+                np.asarray(pool.signature())
+                zeros = np.zeros((pool.lanes,), dtype=np.int32)
                 K = MIN_LEAP
                 while K <= self.max_leap:
-                    pool.mesh = _get_fleet_leap(pool.cfg, K)(pool.mesh, zeros)
+                    pool.leap(K, zeros)
                     K <<= 1
         self._emit_standalone(
             "serve_event", event="warm", request_id=-1, lane=-1,

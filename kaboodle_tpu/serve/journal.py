@@ -51,12 +51,31 @@ class ServeJournal:
     """
 
     def __init__(
-        self, journal_dir: str, fsync: bool = False, compact_every: int = 256
+        self,
+        journal_dir: str,
+        fsync: bool = False,
+        compact_every: int = 256,
+        owner: str | None = None,
     ) -> None:
         self.dir = os.fspath(journal_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.wal_path = os.path.join(self.dir, "wal.jsonl")
         self.manifest_path = os.path.join(self.dir, "manifest.json")
+        self.owner = owner
+        if owner is not None:
+            # Claim (or re-claim) the directory: a federation engine that
+            # opens another engine's journal for WRITING is a deployment
+            # bug — refused loudly here, before any append could interleave
+            # two engines' transitions. Read-side failover replay goes
+            # through :func:`replay_journal`, which claims nothing.
+            owner_path = os.path.join(self.dir, "owner.json")
+            existing = journal_owner(self.dir)
+            if existing is not None and existing != owner:
+                raise ValueError(
+                    f"journal {self.dir} is owned by alien engine "
+                    f"{existing!r} (this engine: {owner!r})"
+                )
+            _write_json_atomic(owner_path, {"owner": owner})
         self.fsync = bool(fsync)
         self.compact_every = int(compact_every)
         self._appends_since_compact = 0
@@ -117,15 +136,15 @@ class ServeJournal:
         WAL. The snapshot lands atomically BEFORE the WAL is cut, so a
         crash between the two replays some transitions twice into the same
         folded rows — idempotent by construction."""
-        _write_json_atomic(
-            self.manifest_path,
-            {
-                "version": JOURNAL_VERSION,
-                "next_rid": int(next_rid),
-                "next_seq": self._seq,
-                "requests": {str(rid): row for rid, row in table.items()},
-            },
-        )
+        snap = {
+            "version": JOURNAL_VERSION,
+            "next_rid": int(next_rid),
+            "next_seq": self._seq,
+            "requests": {str(rid): row for rid, row in table.items()},
+        }
+        if self.owner is not None:
+            snap["owner"] = self.owner
+        _write_json_atomic(self.manifest_path, snap)
         self._f.close()
         self._f = open(self.wal_path, "w")
         if self.fsync:
@@ -144,31 +163,7 @@ class ServeJournal:
         transition>, "req": {...}, "result": ..., "spill_path": ...,
         "saved_run": ..., "extra_ticks": <sum of ticks-mode resume
         budgets>}`` — everything recover needs, nothing engine-internal."""
-        table: dict[int, dict] = {}
-        next_rid = 0
-        if os.path.exists(self.manifest_path):
-            with open(self.manifest_path) as f:
-                snap = json.load(f)
-            if snap.get("version") != JOURNAL_VERSION:
-                raise ValueError(
-                    f"journal manifest version {snap.get('version')!r} != "
-                    f"{JOURNAL_VERSION}"
-                )
-            table = {int(rid): row for rid, row in snap["requests"].items()}
-            next_rid = int(snap["next_rid"])
-        if os.path.exists(self.wal_path):
-            with open(self.wal_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail write: the crash point
-                    self._fold(table, rec)
-                    next_rid = max(next_rid, int(rec["rid"]) + 1)
-        return table, next_rid
+        return _replay_paths(self.manifest_path, self.wal_path)
 
     @staticmethod
     def _fold(table: dict[int, dict], rec: dict) -> None:
@@ -194,6 +189,13 @@ class ServeJournal:
             row["ts_us"] = int(rec["ts_us"])
         if op == "submitted":
             row["req"] = rec.get("req")
+        elif op == "adopted":
+            # A failover handover: submitted + spilled in one record, the
+            # spill file still owned (stamped) by the dead engine.
+            row["req"] = rec.get("req")
+            row["spill_path"] = rec.get("path")
+            row["saved_run"] = rec.get("saved_run")
+            row["spill_owner"] = rec.get("owner")
         elif op == "harvested":
             row["result"] = rec.get("result")
             row["event"] = rec.get("event")
@@ -207,6 +209,59 @@ class ServeJournal:
             row["saved_run"] = rec.get("saved_run")
         elif op == "spill_failed":
             pass  # lane still held (or cache retried); last op stands
+
+
+def _replay_paths(
+    manifest_path: str, wal_path: str
+) -> tuple[dict[int, dict], int]:
+    table: dict[int, dict] = {}
+    next_rid = 0
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            snap = json.load(f)
+        if snap.get("version") != JOURNAL_VERSION:
+            raise ValueError(
+                f"journal manifest version {snap.get('version')!r} != "
+                f"{JOURNAL_VERSION}"
+            )
+        table = {int(rid): row for rid, row in snap["requests"].items()}
+        next_rid = int(snap["next_rid"])
+    if os.path.exists(wal_path):
+        with open(wal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn tail write: the crash point
+                ServeJournal._fold(table, rec)
+                next_rid = max(next_rid, int(rec["rid"]) + 1)
+    return table, next_rid
+
+
+def replay_journal(journal_dir: str) -> tuple[dict[int, dict], int]:
+    """Read-only fold of an engine's journal — no append handle, no owner
+    claim, no compaction. The router's failover path replays a DEAD
+    engine's directory through here; constructing a :class:`ServeJournal`
+    instead would steal the directory (owner claim) and truncate evidence
+    a post-mortem might want."""
+    d = os.fspath(journal_dir)
+    return _replay_paths(
+        os.path.join(d, "manifest.json"), os.path.join(d, "wal.jsonl")
+    )
+
+
+def journal_owner(journal_dir: str) -> str | None:
+    """The engine-id that owns ``journal_dir`` (``None`` when unclaimed or
+    unreadable — a single-engine era journal)."""
+    path = os.path.join(os.fspath(journal_dir), "owner.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("owner")
+    except (OSError, json.JSONDecodeError, ValueError, AttributeError):
+        return None
 
 
 def read_journal_records(journal_dir: str) -> list[dict]:

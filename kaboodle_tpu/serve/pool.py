@@ -216,10 +216,19 @@ class LanePool:
 
         # Program set, process-cached: state_kwargs must be hashable
         # (init_state shape knobs — ints/bools), which the tuple() enforces.
-        kw_items = tuple(sorted(self.state_kwargs.items()))
-        self._step = _step_program(self.cfg, self.chunk, faulty, telemetry)
+        self._bind_programs(tuple(sorted(self.state_kwargs.items())))
+
+    def _bind_programs(self, kw_items: tuple) -> None:
+        """Look up (building on first use) the pool's warmed program set.
+        The sharded pool overrides this with the GSPMD twins — the one
+        seam between the two pool kinds; every lifecycle method above
+        dispatches through these bindings."""
+        self._step = _step_program(
+            self.cfg, self.chunk, self.faulty, self.telemetry
+        )
         self._reseed = {
-            name: _reseed_program(n, name, kw_items) for name in SCENARIOS
+            name: _reseed_program(self.n, name, kw_items)
+            for name in SCENARIOS
         }
         self._insert = _insert_program()
         self._agree = _agree_program()
@@ -382,6 +391,27 @@ class LanePool:
         k = k_m.astype(np.int32)
         self.remaining = self.remaining - k
         self.ticks_run = self.ticks_run + k
+
+    # -- warp dispatch hooks -----------------------------------------------
+
+    def signature(self):
+        """Device ``[E]`` Warp 2.0 signature rows (one vmapped fetch).
+
+        The engine's leap classifier reads these; routing the fetch
+        through the pool lets the sharded pool serve it from its own
+        placement without the engine knowing which kind it drives."""
+        from kaboodle_tpu.warp.runner import _fleet_signature
+
+        return _fleet_signature(self.cfg)(self.mesh)
+
+    def leap(self, K: int, k_m: np.ndarray) -> None:
+        """One masked fleet-leap dispatch (bucket ``K``): every lane
+        advances its own ``k_m[e] <= K`` ticks; ``k_m[e] == 0`` freezes
+        the lane bit-exactly. Host budget accounting is the caller's
+        :meth:`advance_leaped` — this moves only the device mesh."""
+        from kaboodle_tpu.warp.runner import _get_fleet_leap
+
+        self.mesh = _get_fleet_leap(self.cfg, K)(self.mesh, jnp.asarray(k_m))
 
     def agreement(self):
         """Vmapped end-state agreement rows ``(converged, fp_min, fp_max,

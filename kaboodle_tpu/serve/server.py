@@ -129,6 +129,7 @@ class ServeServer:
         )
         engine.on_event = self._on_event
         self._subscribers: set[_Subscriber] = set()
+        self._conn_writers: set = set()  # conc: event-loop
         self._waiters: dict[int, list[asyncio.Future]] = {}
         self._wake = asyncio.Event()
         self._closed = asyncio.Event()
@@ -195,6 +196,35 @@ class ServeServer:
         if self.manifest is not None:
             self.manifest.close()
 
+    async def kill(self) -> None:
+        """Die like a crashed process (the federation chaos hook): stop
+        the listener, abort every open connection mid-op, cancel the
+        round loop — WITHOUT closing the engine, flushing spill I/O, or
+        releasing the journal. Whatever the WAL and spill files hold at
+        this instant is exactly what a failover replay gets to see."""
+        self._closed.set()
+        self._wake.set()
+        for srv in (self._server, self._metrics_server):
+            if srv is not None:
+                srv.close()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+        for writer in list(self._conn_writers):
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()  # RST, not FIN: clients see a break
+        for sub in self._subscribers:
+            sub.push_sentinel()
+        for futs in self._waiters.values():
+            for fut in futs:
+                if not fut.done():
+                    fut.cancel()
+        self._waiters.clear()
+
     async def _engine_loop(self) -> None:
         while not self._closed.is_set():
             if self.engine.busy:
@@ -245,6 +275,7 @@ class ServeServer:
     # -- connections -------------------------------------------------------
 
     async def _handle(self, reader, writer) -> None:
+        self._conn_writers.add(writer)
         try:
             while not self._closed.is_set():
                 line = await reader.readline()
@@ -262,6 +293,7 @@ class ServeServer:
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
         finally:
+            self._conn_writers.discard(writer)
             writer.close()
 
     async def _dispatch(self, op: dict, writer):
@@ -269,6 +301,17 @@ class ServeServer:
         if name == "submit":
             kw = {k: op[k] for k in _SUBMIT_FIELDS if k in op}
             rid = self.engine.submit(ServeRequest(**kw))
+            self._wake.set()
+            return {"ok": True, "request_id": rid}
+        if name == "adopt":
+            # Federation failover handover: take over a dead engine's
+            # spilled request (its checkpoint file, its saved run
+            # counters, its owner stamp) as a fresh rid on this engine.
+            kw = {k: op[k] for k in _SUBMIT_FIELDS if k in op}
+            rid = self.engine.adopt(
+                ServeRequest(**kw), op["spill_path"],
+                op.get("saved_run"), op.get("owner"),
+            )
             self._wake.set()
             return {"ok": True, "request_id": rid}
         if name == "status":
@@ -372,6 +415,27 @@ def main(argv=None) -> int:
                              "(the pre-hardening baseline; for A/B only)")
     parser.add_argument("--journal-dir", default=None,
                         help="write-ahead journal directory (crash recovery)")
+    parser.add_argument("--engine-id", default=None,
+                        help="federation member identity: namespaces spill "
+                             "and journal paths one level down and stamps "
+                             "every checkpoint, so engines can share roots")
+    parser.add_argument("--federated", action="store_true",
+                        help="run the federation ROUTER instead of an "
+                             "engine (needs --members; optional "
+                             "--journal-root enables WAL failover)")
+    parser.add_argument("--members", default=None,
+                        help="federation members as id=host:port,... "
+                             "(each id must match that engine's "
+                             "--engine-id)")
+    parser.add_argument("--journal-root", default=None,
+                        help="the members' SHARED --journal-dir root, for "
+                             "failover replay")
+    parser.add_argument("--spill-root", default=None,
+                        help="the members' SHARED --spill-dir root (spill "
+                             "files must be reachable for adoption)")
+    parser.add_argument("--vnodes", type=int, default=64,
+                        help="virtual nodes per member on the placement "
+                             "ring")
     parser.add_argument("--recover", action="store_true",
                         help="replay --journal-dir before serving: re-queue "
                              "lost requests, re-attach spilled ones")
@@ -408,6 +472,37 @@ def main(argv=None) -> int:
 
         return run_obs_dryrun()
 
+    if args.federated:
+        if not args.members:
+            parser.error("--federated needs --members id=host:port,...")
+        from kaboodle_tpu.serve.federation.router import (
+            FedRouter,
+            parse_members,
+        )
+
+        async def run_router() -> None:
+            router = FedRouter(
+                parse_members(args.members), host=args.host, port=args.port,
+                journal_root=args.journal_root, spill_root=args.spill_root,
+                vnodes=args.vnodes, metrics_port=args.metrics_port,
+            )
+            await router.start()
+            print(f"federation router on {router.host}:{router.port} "
+                  f"(members {sorted(router.alive)})", flush=True)
+            if router.metrics_port is not None:
+                print(f"metrics on http://{router.host}:"
+                      f"{router.metrics_port}/metrics", flush=True)
+            try:
+                await router.serve_forever()
+            finally:
+                await router.close()
+
+        try:
+            asyncio.run(run_router())
+        except KeyboardInterrupt:
+            pass
+        return 0
+
     from kaboodle_tpu.serve.pool import LanePool, lane_n_class
 
     pools = []
@@ -428,7 +523,7 @@ def main(argv=None) -> int:
         pools, warp=not args.no_warp, max_leap=args.max_leap,
         spill_after=args.spill_after, spill_dir=args.spill_dir,
         sync_spill=args.sync_spill, journal_dir=args.journal_dir,
-        admission=admission,
+        admission=admission, engine_id=args.engine_id,
         obs=args.obs or args.metrics_port is not None,
     )
     if args.recover:

@@ -1,0 +1,287 @@
+"""The GSPMD lane pool: one pool's ``[E]`` lanes spread over a device mesh.
+
+A :class:`ShardedLanePool` is a :class:`~kaboodle_tpu.serve.pool.LanePool`
+whose resident fleet lives on a ``fleet.sharding`` device mesh instead of
+one chip: the ``[E]`` lane axis splits across the ``ensemble`` mesh axis
+and — on a 2-D ``E x peers`` mesh — each lane's ``[N]`` peer rows split
+across the ``peers`` axis, so ONE pool serves big-N requests whose state
+exceeds a single device while the small-N classes keep packing one chip
+each. The admission protocol is untouched: same host run vectors, same
+traced-lane reseed/insert/gather, same serve-step contract — the pool
+overrides exactly one seam (``_bind_programs``) plus the warp dispatch
+hooks, swapping in the sharded twins:
+
+- **serve step** — ``phasegraph.derive.make_sharded_serve_step``: the
+  masked converge chunk with its lane-pool carry constrained back onto the
+  mesh after every tick, so XLA partitions every while_loop iteration
+  identically (lanes tick device-locally; only ``any(~done)`` and, on 2-D,
+  the per-member row collectives cross the ICI).
+- **reseed / insert** — the same scatter programs with outputs pinned to
+  the fleet layout. Without the pin, XLA would pick each output's sharding
+  per program and the drifted mesh would hand the NEXT dispatch a fresh
+  input sharding — a recompile. Restored members are ``device_put`` onto a
+  canonical placement first, so a warmup insert and a disk restore
+  dispatch the same executable.
+- **fleet leap** — the masked Warp 2.0 span program, vmapped then
+  constrained, cached in the warp ``leap_cache`` under a mesh-distinct
+  family key (same pow2 bucket vocabulary, same exact-composition
+  semantics).
+
+Bit-exactness vs the single-device pool on the same admission schedule is
+pinned by tests/test_fedserve.py; ``with_sharding_constraint`` moves
+bytes, never values, and every per-lane computation stays member-local.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.fleet.core import FleetState
+from kaboodle_tpu.fleet.sharding import (
+    _check_fleet_divisible,
+    _named,
+    fleet_vector_sharding,
+    make_fleet_constrainer,
+    shard_fleet,
+)
+from kaboodle_tpu.parallel.mesh import PEER_AXIS, state_specs
+from kaboodle_tpu.serve.pool import (
+    SCENARIOS,
+    LanePool,
+    make_insert_fn,
+    make_reseed_fn,
+)
+
+
+def member_sharding(device_mesh: Mesh, member):
+    """Canonical placement for ONE member's ``MeshState`` on the pool's
+    device mesh: peer-layer row sharding when the mesh has a ``peers``
+    axis, fully replicated otherwise. Both the warmup insert and a disk
+    restore pin members here before dispatch, so the insert program sees
+    one input sharding forever."""
+    peers = PEER_AXIS in device_mesh.axis_names
+    specs = state_specs(member)
+    if not peers:
+        specs = jax.tree.map(
+            lambda s: P(), specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    return _named(device_mesh, specs)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_step_program(
+    cfg, chunk: int, faulty: bool, telemetry: bool, device_mesh: Mesh
+):
+    """The jitted sharded serve step, shared process-wide (the sharded
+    twin of ``pool._step_program``; ``jax.sharding.Mesh`` hashes, so the
+    device mesh rides in the cache key)."""
+    from kaboodle_tpu.phasegraph.derive import make_sharded_serve_step
+
+    return jax.jit(
+        make_sharded_serve_step(
+            cfg, chunk, device_mesh, faulty=faulty, telemetry=telemetry
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_reseed_program(
+    n: int, scenario: str, state_kwargs_items: tuple, device_mesh: Mesh
+):
+    base = make_reseed_fn(n, scenario=scenario, **dict(state_kwargs_items))
+    constrain = make_fleet_constrainer(device_mesh)
+    vec = fleet_vector_sharding(device_mesh)
+
+    def reseed(mesh, generation, drop_rate, lane, seed, drop):
+        mesh, generation, drop_rate = base(
+            mesh, generation, drop_rate, lane, seed, drop
+        )
+        mesh = constrain(mesh)
+        generation = jax.lax.with_sharding_constraint(generation, vec)
+        drop_rate = jax.lax.with_sharding_constraint(drop_rate, vec)
+        return mesh, generation, drop_rate
+
+    return jax.jit(reseed)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_insert_jit(device_mesh: Mesh):
+    base = make_insert_fn()
+    constrain = make_fleet_constrainer(device_mesh)
+    vec = fleet_vector_sharding(device_mesh)
+
+    def insert(mesh, generation, lane, member):
+        mesh, generation = base(mesh, generation, lane, member)
+        return constrain(mesh), jax.lax.with_sharding_constraint(
+            generation, vec
+        )
+
+    return jax.jit(insert)
+
+
+def _sharded_insert_program(device_mesh: Mesh):
+    """The restore scatter with a placement prologue: the member pytree is
+    pinned to :func:`member_sharding` BEFORE the jitted dispatch, so a
+    host-loaded checkpoint member and the warmup's gathered member hit the
+    same compiled executable (jit keys on input shardings — letting them
+    differ would mint a steady-state compile on the first real restore)."""
+    jitted = _sharded_insert_jit(device_mesh)
+
+    def insert(mesh, generation, lane, member):
+        member = jax.device_put(member, member_sharding(device_mesh, member))
+        return jitted(mesh, generation, lane, member)
+
+    return insert
+
+
+def _sharded_fleet_leap(cfg, K: int, device_mesh: Mesh):
+    """The masked fleet leap constrained onto the device mesh, cached in
+    the warp ``leap_cache`` under a mesh-distinct family key — the pow2
+    bucket vocabulary (and the cache's reuse accounting) is shared with
+    the single-device fleet family."""
+    from kaboodle_tpu.phasegraph.derive import make_warp_leap
+    from kaboodle_tpu.warp.runner import leap_cache
+
+    def build():
+        leap = jax.vmap(make_warp_leap(cfg, K, hybrid=True, masked=True))
+        constrain = make_fleet_constrainer(device_mesh)
+
+        def sharded_leap(mesh, k_m):
+            return constrain(leap(mesh, k_m))
+
+        return jax.jit(sharded_leap)
+
+    return leap_cache.get((cfg, "fleet-sharded", device_mesh), "hybrid", K, build)
+
+
+class ShardedLanePool(LanePool):
+    """A lane pool resident on a GSPMD device mesh (see module docstring).
+
+    ``device_mesh`` is a ``fleet.sharding.make_fleet_mesh`` mesh — 1-D
+    ``ensemble`` (each lane whole on one chip) or 2-D ``E x peers`` (each
+    lane's rows split too). ``lanes`` must divide by the ensemble mesh
+    size and ``n`` by the peer mesh size, exactly like ``shard_fleet``.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        lanes: int,
+        cfg: SwimConfig | None = None,
+        faulty: bool = False,
+        telemetry: bool = False,
+        chunk: int = 8,
+        device_mesh: Mesh | None = None,
+        **state_kwargs,
+    ) -> None:
+        if device_mesh is None:
+            from kaboodle_tpu.fleet.sharding import make_fleet_mesh
+
+            device_mesh = make_fleet_mesh()
+        self.device_mesh = device_mesh
+        super().__init__(
+            n, lanes, cfg=cfg, faulty=faulty, telemetry=telemetry,
+            chunk=chunk, **state_kwargs,
+        )
+        _check_fleet_divisible(lanes, n, device_mesh)
+        # Re-place the freshly initialized resident onto the mesh; the
+        # host run vectors stay host numpy, exactly like the base pool.
+        fleet = shard_fleet(
+            FleetState(mesh=self.mesh, drop_rate=self.drop), device_mesh
+        )
+        self.mesh = fleet.mesh
+        self.drop = fleet.drop_rate
+        self.generation = jax.device_put(
+            self.generation, fleet_vector_sharding(device_mesh)
+        )
+
+    def _bind_programs(self, kw_items: tuple) -> None:
+        self._step = _sharded_step_program(
+            self.cfg, self.chunk, self.faulty, self.telemetry,
+            self.device_mesh,
+        )
+        self._reseed = {
+            name: _sharded_reseed_program(
+                self.n, name, kw_items, self.device_mesh
+            )
+            for name in SCENARIOS
+        }
+        self._insert = _sharded_insert_program(self.device_mesh)
+        # The agreement fetch reads [E] rows to host — no mesh output, so
+        # the shared vmapped program just compiles a sharded-input
+        # executable at warmup; same for the signature/member gathers.
+        from kaboodle_tpu.serve.pool import _agree_program
+
+        self._agree = _agree_program()
+
+    def member_snapshot(self, lane: int):
+        """A zero-arg thunk for the spill writer, with the gather program
+        dispatched HERE, on the round-loop thread. The sharded gather
+        contains collectives (it reassembles a member from its shards);
+        dispatching it from the background spill thread would interleave
+        its rendezvous with the concurrently running step program's and
+        deadlock the device set. Dispatch is asynchronous — the round loop
+        pays a program launch, the worker thread pays the device->host
+        transfer, same split of blocking work as the base pool."""
+        member = self.member(lane)
+        return lambda: member
+
+    # -- warp dispatch hooks -----------------------------------------------
+
+    def leap(self, K: int, k_m) -> None:
+        self.mesh = _sharded_fleet_leap(self.cfg, K, self.device_mesh)(
+            self.mesh, jnp.asarray(k_m)
+        )
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """The base warmup plus the sharded pool's host-FETCH programs:
+        reading a sharded array back to host compiles a per-(shape,
+        sharding) assembly program (jax's ``_multi_slice``), which counts
+        against the zero-recompile budget exactly like a dispatch. The
+        base warmup covers the step/signature/agreement outputs by running
+        them; the two fetches it never performs are the generation-counter
+        read (every admit does one) and the member-leaf reads (every spill
+        write does one per ``MeshState`` field), so both are exercised
+        here. The mirror direction needs warming too: a restore's
+        checkpoint-loaded member arrives as single-device arrays, and
+        SPLITTING each leaf onto the mesh is another per-(shape, sharding)
+        program — exercised by re-inserting lane 0's own state through a
+        host round-trip (bit-identical, state-preserving)."""
+        super().warmup()
+        np.asarray(self.generation)
+        host_member = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x)), self.member(0)
+        )
+        self.mesh, self.generation = self._insert(
+            self.mesh, self.generation, jnp.int32(0), host_member
+        )
+
+    # -- checkpoint adoption -----------------------------------------------
+
+    def load_fleet_state(self, fleet: FleetState, generation) -> None:
+        """Adopt a checkpointed resident, re-placing it onto the mesh (a
+        host-loaded fleet arrives unsharded)."""
+        super().load_fleet_state(fleet, generation)
+        placed = shard_fleet(
+            FleetState(mesh=self.mesh, drop_rate=self.drop), self.device_mesh
+        )
+        self.mesh = placed.mesh
+        self.drop = placed.drop_rate
+        self.generation = jax.device_put(
+            self.generation, fleet_vector_sharding(self.device_mesh)
+        )
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["device_mesh"] = {
+            axis: int(size) for axis, size in self.device_mesh.shape.items()
+        }
+        return out

@@ -58,7 +58,10 @@ class SpillManager:
     stays single-threaded from the device's point of view.
     """
 
-    def __init__(self, depth: int = 4) -> None:
+    def __init__(self, depth: int = 4, owner: str | None = None) -> None:
+        # Writer identity stamped into every spill archive (federation
+        # engine-id): restores can then refuse alien engines' files.
+        self.owner = owner
         self._work: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
         # KB506 waiver: fed only by the bounded _work queue (one completion
         # per submitted item) and drained to empty by the engine's
@@ -175,7 +178,8 @@ class SpillManager:
                         with self._lock:
                             if rid in self._cache:
                                 self._cache[rid] = member
-                    checkpoint.save(path, member, atomic=True)
+                    checkpoint.save(path, member, atomic=True,
+                                    owner=self.owner)
                     # Durable: the file supersedes the host copy.
                     with self._lock:
                         self._cache.pop(rid, None)

@@ -1,0 +1,416 @@
+"""fedserve (ISSUE 17): sharded lane pools + federated serving — unit lanes.
+
+The end-to-end federation proof (two engines + router, kill-one-engine
+failover, zero lost terminals, zero steady compiles) lives in
+``make fedserve-dryrun`` (kaboodle_tpu/serve/federation/fedload.py); this
+file pins the pieces in isolation:
+
+- the consistent-hash ring's stability / determinism / affinity contracts,
+- the router's N-class-aware load-scored placement (no sockets),
+- the sharded lane pool's bit-exact parity with the single-device pool and
+  its zero-recompile contract through a full spill/restore engine cycle,
+- the engine-id namespace guards (checkpoint owner stamps, journal owner
+  claims, torn-WAL tolerance) and the explicit ``adopt`` handover, and
+- the client's reconnect-with-backoff resuming a ``wait`` across a server
+  kill+restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.errors import CheckpointError
+from kaboodle_tpu.serve.engine import ServeEngine, ServeRequest
+from kaboodle_tpu.serve.federation.ring import HashRing, stable_hash
+from kaboodle_tpu.serve.federation.router import EngineMember, FedRouter
+from kaboodle_tpu.serve.pool import LanePool
+
+CFG = SwimConfig(deterministic=True)
+N = 16
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        eq = np.issubdtype(x.dtype, np.floating)
+        if not np.array_equal(x, y, equal_nan=eq):
+            return False
+    return True
+
+
+# -- consistent-hash ring ---------------------------------------------------
+
+
+def test_stable_hash_is_process_independent():
+    # Pinned values: blake2b is deterministic across processes and
+    # restarts, unlike the salted builtin hash. A changed pin means the
+    # whole fleet's placement moved — never do that silently.
+    assert stable_hash("default:16:0") == stable_hash("default:16:0")
+    assert stable_hash("a") != stable_hash("b")
+    assert stable_hash("a") == 0x40F89E395B66422F
+
+
+def test_ring_determinism_across_instances():
+    a = HashRing(["e0", "e1", "e2"])
+    b = HashRing(["e2", "e0", "e1"])  # insertion order must not matter
+    keys = [f"t{i % 3}:16:{i}" for i in range(500)]
+    assert [a.place(k) for k in keys] == [b.place(k) for k in keys]
+
+
+def test_ring_join_leave_stability():
+    members = [f"e{i}" for i in range(5)]
+    ring = HashRing(members)
+    keys = [f"default:16:{i}" for i in range(2000)]
+    before = {k: ring.place(k) for k in keys}
+
+    ring.remove("e2")
+    after = {k: ring.place(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # Only the dead member's keys move, and it owned ~1/5 of the space.
+    assert all(before[k] == "e2" for k in moved)
+    assert 0.05 < len(moved) / len(keys) < 0.40
+
+    ring.add("e2")  # a re-join restores the original placement exactly
+    assert {k: ring.place(k) for k in keys} == before
+
+
+def test_ring_preference_walk():
+    ring = HashRing(["e0", "e1", "e2"])
+    for i in range(50):
+        prefs = ring.preference(f"k{i}")
+        assert prefs[0] == ring.place(f"k{i}")
+        assert sorted(prefs) == ["e0", "e1", "e2"]  # distinct, all members
+    assert ring.preference("k0", limit=2) == ring.preference("k0")[:2]
+    assert ring.size == 3 * 64
+
+
+def _placement_router() -> FedRouter:
+    """A router with hand-attached members — placement is pure table
+    logic, no sockets needed."""
+    r = FedRouter([EngineMember("e0", "h", 1), EngineMember("e1", "h", 2),
+                   EngineMember("e2", "h", 3)])
+    for mid in ("e0", "e1", "e2"):
+        r.ring.add(mid)
+        r.alive.add(mid)
+        r._inflight[mid] = 0
+    r._classes = {"e0": {16, 32}, "e1": {16}, "e2": {16}}
+    return r
+
+
+def test_placement_nclass_affinity():
+    r = _placement_router()
+    # Only e0 serves N-class 32: every 32-key lands there regardless of
+    # where the ring would put it.
+    for i in range(40):
+        assert r._place(f"default:32:{i}", 32) == "e0"
+    # At equal load the ring's choice stands (deterministic affinity).
+    for i in range(40):
+        key = f"default:16:{i}"
+        want = [m for m in r.ring.preference(key) if 16 in r._classes[m]][0]
+        assert r._place(key, 16) == want
+
+
+def test_placement_load_slack_overflow():
+    r = _placement_router()
+    key = next(
+        f"default:16:{i}" for i in range(100)
+        if r._place(f"default:16:{i}", 16) == "e1"
+    )
+    r._inflight["e1"] = r.load_slack - 1
+    assert r._place(key, 16) == "e1"  # within slack: affinity holds
+    r._inflight["e1"] = r.load_slack
+    assert r._place(key, 16) != "e1"  # overflow: least-loaded candidate
+
+
+def test_no_engine_serves_class_raises():
+    r = _placement_router()
+    with pytest.raises(ValueError, match="N-class 64"):
+        r._place("default:64:0", 64)
+
+
+# -- sharded lane pool ------------------------------------------------------
+
+
+def _mesh_2d():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from kaboodle_tpu.fleet.sharding import make_fleet_mesh
+
+    return make_fleet_mesh(4, 2)
+
+
+def test_sharded_pool_parity_bit_exact():
+    """Same admission schedule, same steps: every member leaf and every
+    host run vector identical between the single-device pool and the
+    sharded pool on a 2-D (ensemble x peers) mesh."""
+    from kaboodle_tpu.serve.shardpool import ShardedLanePool
+
+    device_mesh = _mesh_2d()
+    kw = dict(n=N, lanes=4, cfg=CFG, chunk=4)
+    ref = LanePool(**kw)
+    sh = ShardedLanePool(device_mesh=device_mesh, **kw)
+    for lane, (seed, conv) in enumerate([(0, True), (1, False), (2, True)]):
+        for p in (ref, sh):
+            p.admit(lane, seed=seed, drop_rate=0.0, until_conv=conv,
+                    budget=16, scenario="boot" if conv else "steady")
+    for _ in range(6):
+        ref.step()
+        sh.step()
+    for lane in range(3):
+        assert _leaves_equal(ref.member(lane), sh.member(lane)), lane
+    for name in ("ticks_run", "conv_tick", "messages"):
+        assert np.array_equal(getattr(ref, name), getattr(sh, name)), name
+
+
+def test_sharded_pool_zero_recompile_through_spill_cycle(tmp_path):
+    """The KB405 pin on the sharded pool: a full engine lifecycle —
+    submit / drain / park / spill / restore / resume — dispatches ZERO
+    fresh compiles after warmup, including the host-fetch and
+    mesh-split assembly programs (the two hazards warmup pre-warms)."""
+    from kaboodle_tpu.analysis.ir.surface import compile_counter
+    from kaboodle_tpu.serve.shardpool import ShardedLanePool
+
+    device_mesh = _mesh_2d()
+    pool = ShardedLanePool(n=N, lanes=4, cfg=CFG, chunk=4,
+                           device_mesh=device_mesh)
+    eng = ServeEngine(
+        [pool], max_leap=64, spill_after=1, spill_dir=str(tmp_path),
+        journal_dir=str(tmp_path / "wal"), engine_id="e0",
+    )
+    eng.warmup()
+    with compile_counter() as fresh:
+        rids = [
+            eng.submit(ServeRequest(
+                n=N, seed=i, mode="converge" if i % 2 == 0 else "ticks",
+                ticks=16, keep=True))
+            for i in range(3)
+        ]
+        eng.drain()
+        for _ in range(40):
+            eng.step()
+            if all(eng.status(r)["state"] == "spilled" for r in rids):
+                break
+        eng.settle_spills()
+        for r in rids:
+            assert eng.status(r)["state"] == "spilled", eng.status(r)
+            assert eng.restore(r)
+            eng.resume(r, mode="ticks", ticks=4)
+        eng.drain()
+        eng.settle_spills()
+    assert fresh.count == 0, f"{fresh.count} fresh compiles after warmup"
+    assert pool.stats()["device_mesh"] == {"ensemble": 4, "peers": 2}
+    eng.close()
+
+
+# -- engine-id namespaces and owner guards ----------------------------------
+
+
+def test_checkpoint_owner_stamp_guards(tmp_path):
+    from kaboodle_tpu import checkpoint
+    from kaboodle_tpu.sim import init_state
+
+    st = init_state(N, seed=3)
+    stamped = tmp_path / "stamped.npz"
+    bare = tmp_path / "bare.npz"
+    checkpoint.save(stamped, st, owner="e0")
+    checkpoint.save(bare, st)
+
+    assert checkpoint.checkpoint_owner(stamped) == "e0"
+    assert checkpoint.checkpoint_owner(bare) is None
+    _ = checkpoint.load(stamped, expect_owner="e0")  # the sanctioned path
+    with pytest.raises(CheckpointError, match="alien engine"):
+        checkpoint.load(stamped, expect_owner="e1")
+    with pytest.raises(CheckpointError, match="no owner stamp"):
+        checkpoint.load(bare, expect_owner="e1")
+    # Unstamped-era files stay loadable when no owner is expected.
+    _ = checkpoint.load(bare)
+
+
+def test_journal_owner_claim_refuses_alien_engine(tmp_path):
+    from kaboodle_tpu.serve.journal import (
+        ServeJournal,
+        journal_owner,
+        replay_journal,
+    )
+
+    d = str(tmp_path / "j")
+    j = ServeJournal(d, owner="e0")
+    j.append("submitted", 0, req={"n": 16, "seed": 1})
+    j.close()
+    assert journal_owner(d) == "e0"
+    with pytest.raises(ValueError, match="alien engine"):
+        ServeJournal(d, owner="e1")
+    # Read-side failover replay claims nothing and tolerates a torn tail.
+    with open(os.path.join(d, "wal.jsonl"), "a") as f:
+        f.write('{"op": "harvested", "rid": 0, "resu')
+    table, next_rid = replay_journal(d)
+    assert next_rid == 1
+    assert table[0]["op"] == "submitted"  # the torn record never folded
+    assert journal_owner(d) == "e0"  # replay did not steal the claim
+
+
+def test_engine_id_namespaces_shared_roots(tmp_path):
+    """Two engines pointed at the SAME spill/journal roots land their
+    files one engine-id level down — no collisions, and the failover
+    replay knows exactly which directory is whose."""
+    engines = [
+        ServeEngine([LanePool(N, 2, cfg=CFG, chunk=8)], spill_after=1,
+                    spill_dir=str(tmp_path / "spill"),
+                    journal_dir=str(tmp_path / "wal"), engine_id=eid)
+        for eid in ("e0", "e1")
+    ]
+    try:
+        for eng in engines:
+            assert eng.journal.dir == str(tmp_path / "wal" / eng.engine_id)
+            assert eng.journal.owner == eng.engine_id
+            assert eng.spill_dir == str(tmp_path / "spill" / eng.engine_id)
+    finally:
+        for eng in engines:
+            eng.close()
+
+
+def test_adopt_is_a_journaled_cross_engine_handover(tmp_path):
+    """The failover handover without the router: e0 spills a kept
+    request, e1 adopts the (file, run counters, owner) triple, restores
+    across the owner stamp, and the continuation completes on e1."""
+    from kaboodle_tpu.serve.journal import replay_journal
+
+    def _engine(eid: str) -> ServeEngine:
+        return ServeEngine(
+            [LanePool(N, 2, cfg=CFG, chunk=8)], max_leap=64, spill_after=1,
+            spill_dir=str(tmp_path / "spill"),
+            journal_dir=str(tmp_path / "wal"), engine_id=eid,
+        )
+
+    e0 = _engine("e0")
+    e0.warmup()
+    rid = e0.submit(ServeRequest(n=N, seed=5, mode="ticks", ticks=8,
+                                 keep=True))
+    e0.drain()
+    for _ in range(40):
+        e0.step()
+        if e0.status(rid)["state"] == "spilled":
+            break
+    e0.settle_spills()
+    row = e0.status(rid)
+    assert row["state"] == "spilled"
+    e0.close()  # e0 "dies"; its journal and spill file survive
+
+    table, _ = replay_journal(str(tmp_path / "wal" / "e0"))
+    jrow = table[rid]
+    assert jrow["spill_path"] and os.path.exists(jrow["spill_path"])
+
+    e1 = _engine("e1")
+    e1.warmup()
+    req = {k: v for k, v in jrow["req"].items()}
+    new_rid = e1.adopt(ServeRequest(**req), jrow["spill_path"],
+                       jrow["saved_run"], owner="e0")
+    assert e1.status(new_rid)["state"] == "spilled"
+    assert e1.restore(new_rid)  # loads across the e0 owner stamp
+    e1.resume(new_rid, mode="ticks", ticks=4)
+    e1.drain()
+    done = e1.status(new_rid)
+    assert done["result"] is not None
+    # The continuation's counters carried over: total ticks accumulate.
+    assert done["result"]["ticks_run"] >= 8
+    # Adoption without a pool for the class, or without the file, refuses.
+    with pytest.raises(ValueError, match="no pool"):
+        e1.adopt(ServeRequest(n=1024), jrow["spill_path"], None, "e0")
+    with pytest.raises(CheckpointError, match="missing"):
+        e1.adopt(ServeRequest(n=N), str(tmp_path / "gone.npz"), None, "e0")
+    e1.close()
+
+
+# -- client reconnect across a server kill/restart --------------------------
+
+
+def test_client_reconnect_resumes_wait_across_restart(tmp_path):
+    """A ``wait`` parked on a connection the server KILLS mid-flight
+    (listener gone, transports RST) resumes transparently on the
+    restarted server: request ids are server-side state, the client
+    re-dials with backoff and re-sends the idempotent op."""
+    from kaboodle_tpu.serve.client import ServeClient
+    from kaboodle_tpu.serve.server import ServeServer
+
+    engine = ServeEngine([LanePool(N, 2, cfg=CFG, chunk=8)], max_leap=64)
+    engine.warmup()
+
+    async def drive() -> dict:
+        server = ServeServer(engine, port=0)
+        await server.start()
+        port = server.port
+        client = await ServeClient.connect(
+            port=port, reconnect=True, redial_max=10, redial_backoff=0.05
+        )
+        # Fill both lanes with parked keepers (no spill_dir, so parked
+        # requests hold their lanes); the third request then queues with
+        # no lane available and its wait is DETERMINISTICALLY blocked
+        # until a keeper is cancelled — which we only do after the
+        # kill/restart, so the wait must straddle it.
+        keepers = [
+            await client.submit(N, seed=s, mode="ticks", ticks=4,
+                                scenario="steady", keep=True)
+            for s in (9, 10)
+        ]
+        for _ in range(200):
+            rows = [await client.status(k) for k in keepers]
+            if all(r["state"] == "parked" for r in rows):
+                break
+            await asyncio.sleep(0.02)
+        rid = await client.submit(N, seed=11, mode="ticks", ticks=4,
+                                  scenario="steady")
+        waiter = asyncio.create_task(client.wait(rid))
+        await asyncio.sleep(0.2)
+        assert not waiter.done()
+        await server.kill()
+        await asyncio.sleep(0)
+        assert not waiter.done()  # broken transport, not a lost request
+
+        server2 = ServeServer(engine, host=server.host, port=port)
+        await server2.start()
+        # Free a lane via a second client; the redialed waiter resolves.
+        nudge = await ServeClient.connect(port=port)
+        assert await nudge.cancel(keepers[0])
+        row = await asyncio.wait_for(waiter, 30.0)
+        await nudge.cancel(keepers[1])
+        await nudge.close()
+        await server2.close()
+        return row, rid
+
+    row, rid = asyncio.run(drive())
+    assert row["request_id"] == rid
+    assert row["result"] is not None
+
+
+def test_client_never_resends_submit():
+    """The reconnect surface must not double-run work: a transport break
+    during ``submit`` surfaces as ConnectionError even with reconnect
+    enabled (the server may already have admitted the request)."""
+    from kaboodle_tpu.serve.client import ServeClient, _IDEMPOTENT
+
+    assert "submit" not in _IDEMPOTENT
+    assert "adopt" not in _IDEMPOTENT
+
+    async def drive() -> None:
+        async def handler(reader, writer) -> None:
+            await reader.readline()
+            writer.transport.abort()  # break before any response
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = await ServeClient.connect(port=port, reconnect=True)
+        with pytest.raises((ConnectionError, OSError)):
+            await client.submit(N, seed=1)
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(drive())

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun sparse-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -75,6 +75,7 @@ ci: lint native test
 	$(MAKE) serve-obs-dryrun
 	$(MAKE) costscope-dryrun
 	$(MAKE) fedserve-dryrun
+	$(MAKE) sparse-dryrun
 
 # The fleet sweep dryrun (the `make ci` tail step; the workflow runs this
 # same target — ONE copy of the invocation).
@@ -204,6 +205,15 @@ fedserve-dryrun:
 	timeout 540 env JAX_PLATFORMS=cpu \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m kaboodle_tpu fed-load --dryrun
+
+# Sparseplane dryrun (ISSUE 18): the blocked_topk [N, K] engine — toy-N
+# stat check against the dense oracle (matched-seed convergence band,
+# steady counter means, zero steady recompiles), then a capped
+# million-peer smoke (boot 2^20 peers, a few real ticks, per-peer cost
+# logged). The banked numbers (24-tick curve, sub-quadratic bytes)
+# live in BENCH_sparse.json via `bench.py --sparse`.
+sparse-dryrun:
+	timeout 540 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu sparse --dryrun
 
 # graftscan standalone (mirrors warp-dryrun): the full IR gate — trace the
 # entry-point registry, run KB401-405, compare the compile surface against

@@ -1178,6 +1178,12 @@ def main() -> None:
                         "instead of the standard sections; delegates to "
                         "`python -m kaboodle_tpu serve-load` and writes "
                         "BENCH_serve.json")
+    p.add_argument("--sparse", action="store_true",
+                   help="run the million-peer blocked_topk bench (boot "
+                        "N>=2^20 peers in [N, K] neighbor blocks, per-peer "
+                        "tick cost + convergence curves + the zero-recompile "
+                        "pin + sub-quadratic bytes evidence) instead of the "
+                        "standard sections; writes BENCH_sparse.json")
     p.add_argument("--manifest", metavar="PATH", default=None,
                    help="append the BENCHDOC line as a 'run' record to a "
                         "JSONL telemetry manifest (kaboodle_tpu.telemetry."
@@ -1211,6 +1217,15 @@ def main() -> None:
 
         argv = ["--n", str(args.n)] if args.n else []
         raise SystemExit(serve_load_main(argv))
+
+    if args.sparse:
+        # Thin delegation: the sparse bench owns its own chunked run,
+        # steady-window compile accounting and JSON output
+        # (BENCH_sparse.json); bench.py routes the shared --n knob through.
+        from kaboodle_tpu.sparseplane.bench import main as sparse_bench_main
+
+        argv = ["--n", str(args.n)] if args.n else []
+        raise SystemExit(sparse_bench_main(argv))
 
     if args.warp:
         # Focused warp A/B lanes. 'sparse-fault': ISSUE 3 acceptance (>= 2x

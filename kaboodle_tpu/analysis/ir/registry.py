@@ -148,6 +148,35 @@ def _tick_blocked():
     return fn, (_full_state(), _idle())
 
 
+def _sparse_build(timer_dtype: str):
+    # The blocked_topk steady tick (ISSUE 18): [N, K] neighbor blocks +
+    # counter-based draws. deterministic=False exercises the real
+    # stream_uniform draws; the cfg must be sparse-legal
+    # (join broadcast off, faithful Q3/Q11 on — kernel._validate).
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.phasegraph.derive import make_sparse_tick
+    from kaboodle_tpu.sparseplane import (
+        SparseSpec,
+        init_sparse_state,
+        sparse_idle_inputs,
+    )
+
+    cfg = SwimConfig(deterministic=False, join_broadcast_enabled=False)
+    spec = SparseSpec(k=8, gossip_fanout=2, boot_contacts=2,
+                      timer_dtype=timer_dtype)
+    fn = make_sparse_tick(cfg, spec, faulty=True)
+    return fn, (init_sparse_state(TRACE_N, spec, seed=0),
+                sparse_idle_inputs(TRACE_N))
+
+
+def _tick_sparse():
+    return _sparse_build("int32")
+
+
+def _tick_sparse_lean():
+    return _sparse_build("int16")
+
+
 # -- telemetry-plane builds (ISSUE 6): the telemetry=True twins of the tick
 # programs. Same pass pipeline as their plain counterparts — in particular
 # KB402 proves the counter/recorder plane adds NO host callback, and the
@@ -478,6 +507,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("phasegraph.tick.lean", _tick_lean, lean=True),
     EntryPoint("phasegraph.tick.random", _tick_random),
     EntryPoint("phasegraph.tick.blocked", _tick_blocked),
+    EntryPoint("phasegraph.tick.sparse", _tick_sparse),
+    EntryPoint("phasegraph.tick.sparse.lean", _tick_sparse_lean, lean=True),
     EntryPoint("phasegraph.tick.telemetry", _tick_telemetry),
     EntryPoint("phasegraph.tick.telemetry.lean", _tick_telemetry_lean, lean=True),
     EntryPoint("phasegraph.tick.blocked.telemetry", _tick_blocked_telemetry),

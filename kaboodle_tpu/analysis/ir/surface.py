@@ -10,7 +10,8 @@ just N times slower. This module:
   compilations via jax's monitoring events (cache hits do not fire) —
   shared with the parity fuzz's zero-recompile assertion arm
   (tests/test_fuzz_parity.py);
-- defines the scripted dense+warp+fleet **exercise** — a fixed sequence of
+- defines the scripted dense+warp+fleet+serve+sparse **exercise** — a
+  fixed sequence of
   representative dispatches per entry-point family — and measures how many
   compilations each family triggers;
 - loads/writes the committed budget ``.graftscan_surface.json`` and turns
@@ -326,11 +327,67 @@ def _run_serve(ctx) -> None:
     engine.drain()
 
 
+def _prep_sparse():
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sparseplane import (
+        SparseSpec,
+        init_sparse_state,
+        sparse_idle_inputs,
+    )
+
+    n = _EX_N
+    cfg = SwimConfig(deterministic=True, join_broadcast_enabled=False)
+    spec = SparseSpec(k=8, gossip_fanout=2, boot_contacts=2)
+    lean = SparseSpec(k=8, gossip_fanout=2, boot_contacts=2,
+                      timer_dtype="int16")
+    idle = sparse_idle_inputs(n, ticks=4)
+    variants = (
+        idle,
+        dc.replace(idle, kill=idle.kill.at[1, 2].set(True)),
+        dc.replace(idle, revive=idle.revive.at[2, 2].set(True)),
+        dc.replace(idle, drop_rate=jnp.full((4,), 0.1, jnp.float32)),
+    )
+    return {
+        "cfg": cfg,
+        "spec": spec,
+        "lean": lean,
+        "st": init_sparse_state(n, spec, seed=0),
+        "st2": init_sparse_state(n, spec, seed=7),
+        "stl": init_sparse_state(n, lean, seed=0),
+        "variants": variants,
+    }
+
+
+def _run_sparse(ctx) -> None:
+    """The blocked_topk engine (ISSUE 18): the scanned sparse tick across
+    its input envelope (idle / kill / revive / nonzero drop all share ONE
+    program — drop_rate is traced), the lean int16 build, and the
+    while_loop converge runner; a second converge dispatch from different
+    data must hit the cache. Budget = 3."""
+    from kaboodle_tpu.sparseplane import (
+        run_sparse_until_converged,
+        simulate_sparse,
+    )
+
+    cfg, spec = ctx["cfg"], ctx["spec"]
+    st = ctx["st"]
+    for inp in ctx["variants"]:
+        st, _ = simulate_sparse(st, inp, cfg, spec)
+    simulate_sparse(ctx["stl"], ctx["variants"][0], cfg, ctx["lean"])
+    run_sparse_until_converged(ctx["st"], cfg, spec, max_ticks=64)
+    run_sparse_until_converged(ctx["st2"], cfg, spec, max_ticks=64)
+
+
 EXERCISES: tuple[SurfaceExercise, ...] = (
     SurfaceExercise("dense", _prep_dense, _run_dense),
     SurfaceExercise("warp", _prep_warp, _run_warp),
     SurfaceExercise("fleet", _prep_fleet, _run_fleet),
     SurfaceExercise("serve", _prep_serve, _run_serve),
+    SurfaceExercise("sparse", _prep_sparse, _run_sparse),
 )
 
 
@@ -393,8 +450,8 @@ def write_surface(
     payload = {
         "comment": (
             "graftscan compile-surface budget: distinct XLA compilations per "
-            "entry-point family across the scripted dense+warp+fleet+serve "
-            "exercise "
+            "entry-point family across the scripted "
+            "dense+warp+fleet+serve+sparse exercise "
             "(fresh process — `python -m kaboodle_tpu.analysis --ir`). CI "
             "fails on growth; raising a count requires editing this file "
             "with a justification. Shrink when the measured count drops."

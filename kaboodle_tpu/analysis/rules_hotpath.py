@@ -58,6 +58,13 @@ HOT_DIRS = (
     # inside those bodies would time the sync, not the collective, and a
     # dtype drift changes the payload bytes the ring formulas attribute.
     "kaboodle_tpu/costscope/",
+    # sparseplane/: the blocked_topk [N, K] engine (ISSUE 18) — the only
+    # tick family that scales to million-peer worlds, so its per-tick cost
+    # IS the per-peer budget BENCH_sparse.json banks. A host sync in the
+    # segment-gather kernel or block repair stalls every steady tick, and
+    # an implicit promotion doubles the [N, K] residents the sub-quadratic
+    # claim is built on.
+    "kaboodle_tpu/sparseplane/",
     # analysis/conc/: the graftconc lane (ISSUE 16) is host-side AST + a
     # runtime sanitizer, but the sanitizer's lock wrappers and loop
     # watchdog run INSIDE the serve round loop under chaos/tests — an
@@ -103,6 +110,12 @@ DTYPE_DISCIPLINE_FILES = (
     # (engine.py the FILENAME is already listed for oracle/; names match
     # within HOT_DIRS, so serve/engine.py is covered by that entry.)
     "pool.py",
+    # sparseplane/: kernel.py/state.py ride the entries above (names match
+    # within HOT_DIRS); repair.py's rank-match scatter and rng.py's
+    # counter-draw chain carry the same discipline — int32 neighbor
+    # indices with -1 sentinels, int16/int32 block timers, uint32
+    # (seed, cursor) whose wraparound the checkpoint resume depends on.
+    "repair.py", "rng.py",
     # costscope: the microbench payloads. uint32 fingerprints into pmin/
     # pmax agreement, uint32 all-ones partials into psum_scatter — a
     # promoted payload doubles the bytes the banked GB/s is computed from.

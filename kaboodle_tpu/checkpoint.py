@@ -240,6 +240,67 @@ def load_fleet(path):
     return fleet, generation
 
 
+def save_sparse(path, state, atomic: bool = False) -> None:
+    """Write a ``SparseState`` (blocked-sparse mesh) to ``path`` (.npz).
+
+    One entry per plane (``sparse.`` prefixed) — the neighbor-index /
+    state / timer blocks AND the counter-RNG ``(seed, cursor)`` pair, so a
+    restored mesh reproduces the exact draw sequence an uninterrupted run
+    would have made (counter draws are pure functions of the cursor).
+    Schema-guarded with a ``__sparse__`` marker like ``__fleet__``, so the
+    three checkpoint families can never cross-restore."""
+    from kaboodle_tpu.sparseplane.state import SparseState
+
+    arrays = {
+        "sparse." + f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(SparseState)
+    }
+    arrays["__version__"] = np.int32(_FORMAT_VERSION)
+    arrays["__sparse__"] = np.int32(1)
+    if atomic:
+        _savez_atomic(path, arrays)
+    else:
+        np.savez(path, **arrays)
+
+
+def load_sparse(path):
+    """Read a sparse checkpoint back; returns a ``SparseState``.
+
+    Round-trips bit-exactly (tests/test_checkpoint.py): identical blocks,
+    identical ``(seed, cursor)``, so a resumed sparse run is
+    indistinguishable from an uninterrupted one. Same normalized failure
+    modes as :func:`load`; a dense or fleet archive raises."""
+    from kaboodle_tpu.sparseplane.state import SparseState
+
+    with _open_npz(path) as z:
+        if "__version__" not in z.files:
+            raise CheckpointError(
+                f"not a kaboodle checkpoint (no version entry): {path}"
+            )
+        version = int(z["__version__"])
+        if version != _FORMAT_VERSION:
+            raise CheckpointError(f"unsupported checkpoint version {version}")
+        if "__sparse__" not in z.files:
+            raise CheckpointError(
+                "not a sparse checkpoint (dense mesh? use checkpoint.load)"
+            )
+        fields = {f.name for f in dataclasses.fields(SparseState)}
+        present = {
+            name[len("sparse."):]
+            for name in z.files
+            if name.startswith("sparse.")
+        }
+        missing = fields - present
+        if missing:
+            raise CheckpointError(
+                f"checkpoint missing fields: {sorted(missing)}"
+            )
+        state = SparseState(
+            **{name: jnp.asarray(z["sparse." + name]) for name in fields}
+        )
+    return state
+
+
 _ASYNC_CKPTR = None
 
 

@@ -463,6 +463,13 @@ def main(argv=None) -> int:
         from kaboodle_tpu.costscope.cli import main as costscope_main
 
         return costscope_main(argv[1:])
+    if argv and argv[0] == "sparse":
+        # Blocked-sparse engine dryrun (sparseplane/dryrun.py): toy-N
+        # stat check against the dense oracle + capped million-peer
+        # smoke. The banked numbers live in `bench.py --sparse`.
+        from kaboodle_tpu.sparseplane.dryrun import main as sparse_main
+
+        return sparse_main(argv[1:])
     if argv and argv[0] == "phasegraph":
         # Derived-engine dryrun subcommand (phasegraph/dryrun.py): build
         # every engine the planner derives from the op graph at toy N,

@@ -22,6 +22,10 @@ fleet       the dense tick vmapped over a leading ensemble axis
             contract), so member-vs-standalone parity holds.
 warp        the span program: invariant ops pruned, survivors batched as
             one k-tick scan (``make_warp_leap`` -> span.py)
+sparse      the blocked_topk-layout program: [N, K] neighbor blocks,
+            counter-based draws, bounded block repair
+            (``make_sparse_tick`` -> sparseplane/kernel.py) —
+            distributional twin of the dense oracle, stat-pinned
 ==========  ==============================================================
 
 ``fleet/core.py``, ``parallel/mesh.py``, ``sim/kernel.py``,
@@ -43,7 +47,9 @@ from kaboodle_tpu.config import SwimConfig
 # enters through `kaboodle_tpu.phasegraph` first.
 
 # The engine names, for dryrun/docs enumeration.
-ENGINES = ("dense", "fused", "chunked", "sharded", "fleet", "warp", "serve")
+ENGINES = (
+    "dense", "fused", "chunked", "sharded", "fleet", "warp", "serve", "sparse",
+)
 
 
 def make_dense_tick(
@@ -335,6 +341,42 @@ def make_sharded_serve_step(
         cfg, chunk, faulty=faulty, telemetry=telemetry,
         constrain=make_fleet_constrainer(mesh),
     )
+
+
+def make_sparse_tick(cfg: SwimConfig, spec, faulty: bool = True) -> Callable:
+    """The blocked_topk-layout tick: [N, K] neighbor blocks, counter RNG.
+
+    Derived from the blocked-layout op graph (``build_graph(cfg,
+    layout="blocked_topk")`` + ``plan(graph, "sparse")``); the executable
+    body is sparseplane/kernel.py, whose tail pass grouping is asserted
+    here against the planned program so the kernel and the planner cannot
+    drift silently.  ``spec`` is a hashable
+    :class:`~kaboodle_tpu.sparseplane.state.SparseSpec` (block width K,
+    gossip fanout, boot contacts, timer dtype).  Operates on
+    ``SparseState``/``SparseTickInputs`` — distributionally equivalent to
+    the dense oracle, stat-pinned rather than bit-exact
+    (tests/test_fuzz_parity.py).
+    """
+    from kaboodle_tpu.phasegraph.graph import build_graph
+    from kaboodle_tpu.phasegraph.plan import plan
+    from kaboodle_tpu.sparseplane.kernel import (
+        SPARSE_TAIL_PASSES,
+        make_sparse_tick_fn,
+    )
+
+    graph = build_graph(cfg, faulty=faulty, layout="blocked_topk")
+    program = plan(graph, "sparse")
+    planned = tuple(p.name for p in program.tail)
+    implemented = tuple(n for n in SPARSE_TAIL_PASSES if n in planned)
+    if planned != implemented:
+        raise AssertionError(
+            f"sparse plan/kernel drift: planner tail {planned}, kernel "
+            f"implements {SPARSE_TAIL_PASSES}"
+        )
+    tick = make_sparse_tick_fn(cfg, spec, faulty=faulty)
+    tick.graph = graph
+    tick.programs = {"sparse": program}
+    return tick
 
 
 def make_warp_leap(

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from kaboodle_tpu.phasegraph.ops import PhaseOp, op_table
+from kaboodle_tpu.phasegraph.ops import LAYOUTS, PhaseOp, op_table
 
 
 class GraphError(ValueError):
@@ -32,8 +32,13 @@ class TickGraph:
     ops: tuple[PhaseOp, ...]
     faulty: bool
     telemetry: bool
+    layout: str = "dense"
 
     def __post_init__(self) -> None:
+        if self.layout not in LAYOUTS:
+            raise GraphError(
+                f"unknown layout {self.layout!r} (expected one of {LAYOUTS})"
+            )
         given: set[str] = set()
         names: set[str] = set()
         seen_tail = False
@@ -83,10 +88,20 @@ class TickGraph:
         return tuple(op.cut for op in self.ops if op.cut is not None)
 
 
-def build_graph(cfg, faulty: bool = True, telemetry: bool = False) -> TickGraph:
-    """The validated op graph for one static ``(cfg, faulty, telemetry)`` build."""
+def build_graph(
+    cfg, faulty: bool = True, telemetry: bool = False, layout: str = "dense"
+) -> TickGraph:
+    """The validated op graph for one static build.
+
+    ``layout`` selects the plane format (``ops.LAYOUTS``): a
+    ``blocked_topk`` graph carries the same op vocabulary re-fated for
+    [N, K] neighbor blocks plus the ``block_repair`` tail op; dense-only
+    ops survive in the table and are pruned (with reasons) by
+    ``plan(graph, "sparse")``.
+    """
     return TickGraph(
-        ops=op_table(cfg, faulty=faulty, telemetry=telemetry),
+        ops=op_table(cfg, faulty=faulty, telemetry=telemetry, layout=layout),
         faulty=faulty,
         telemetry=telemetry,
+        layout=layout,
     )

@@ -49,6 +49,46 @@ FIELDS = (
 # TickInputs planes (per-tick scenario inputs).
 INPUTS = ("kill", "revive", "partition", "drop_rate", "drop_ok", "manual_target")
 
+# Plane layouts. "dense" is the [N, N] formulation every engine ran since
+# the seed; "blocked_topk" stores each row's membership view in a [N, K]
+# top-K-neighbor block (K a pow2 knob) with counter-based threefry draws
+# replacing every materialized [N, N] uniform — the million-peer format
+# (kaboodle_tpu/sparseplane/).
+LAYOUTS = ("dense", "blocked_topk")
+
+# Which layouts each persistent plane supports, and what the dense plane
+# decomposes into under blocked_topk. Planes listed dense-only name the
+# subsystems a blocked world compiles out: the join plane needs a broadcast
+# domain, latency/id-view tracking and the KPR ledger are [N, N]-shaped
+# diagnostics whose blocked analogue is the ack-piggyback gossip share.
+PLANE_LAYOUTS = {
+    "S": ("dense", "blocked_topk"),
+    "T": ("dense", "blocked_topk"),
+    "lat": ("dense",),
+    "idv": ("dense",),
+    "alive": ("dense", "blocked_topk"),
+    "identity": ("dense", "blocked_topk"),
+    "never_b": ("dense",),
+    "last_b": ("dense",),
+    "kpr_partner": ("dense",),
+    "kpr_fp": ("dense",),
+    "kpr_n": ("dense",),
+    "tick": ("dense", "blocked_topk"),
+    "key": ("dense", "blocked_topk"),
+}
+
+# The blocked twins each dense plane decomposes into (SparseState fields):
+# S -> (neighbor-index, per-slot state code), T -> per-slot timer, and the
+# carried threefry key -> the checkpointable (seed, cursor) counter pair.
+BLOCKED_PLANES = {
+    "S": ("nbr_idx", "nbr_state"),
+    "T": ("nbr_timer",),
+    "key": ("seed", "cursor"),
+}
+
+# PhaseOp.sparse vocabulary: the op's fate in a blocked_topk build.
+SPARSE_FATES = ("row", "block", "absent")
+
 
 @dataclasses.dataclass(frozen=True)
 class PhaseOp:
@@ -78,10 +118,17 @@ class PhaseOp:
     hybrid: str = "invariant"  # "live" | "sterile" | "invariant"
     sig_term: str | None = None  # activity-signature bit that excludes it
     cut: str | None = None
+    # The op's fate under the blocked_topk layout: "row" (O(N) logic
+    # unchanged), "block" (re-expressed as segment gather/scatter over the
+    # [N, K] blocks), or "absent" (dense-only — the sparse planner prunes
+    # it with a reason).
+    sparse: str = "absent"
 
     def __post_init__(self) -> None:
         if self.stage not in ("prologue", "tail"):
             raise ValueError(f"{self.name}: bad stage {self.stage!r}")
+        if self.sparse not in SPARSE_FATES:
+            raise ValueError(f"{self.name}: bad sparse fate {self.sparse!r}")
         if self.span not in ("live", "degenerate", "invariant"):
             raise ValueError(f"{self.name}: bad span fate {self.span!r}")
         if self.hybrid not in ("live", "sterile", "invariant"):
@@ -98,7 +145,8 @@ class PhaseOp:
 
 def _op(name, phase, doc, stage, *, reads=(), writes=(), inputs=(), gives=(),
         takes=(), activity="always", pred_term=None, mask_rank=1,
-        span="invariant", hybrid=None, sig_term=None, cut=None) -> PhaseOp:
+        span="invariant", hybrid=None, sig_term=None, cut=None,
+        sparse="absent") -> PhaseOp:
     if hybrid is None:
         # Default: whatever still runs in a strict span also runs in the
         # hybrid one; strict-invariant ops stay excluded unless declared.
@@ -109,11 +157,13 @@ def _op(name, phase, doc, stage, *, reads=(), writes=(), inputs=(), gives=(),
         inputs=frozenset(inputs), gives=frozenset(gives),
         takes=frozenset(takes), activity=activity, pred_term=pred_term,
         mask_rank=mask_rank, span=span, hybrid=hybrid, sig_term=sig_term,
-        cut=cut,
+        cut=cut, sparse=sparse,
     )
 
 
-def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp, ...]:
+def op_table(
+    cfg, faulty: bool = True, telemetry: bool = False, layout: str = "dense"
+) -> tuple[PhaseOp, ...]:
     """The tick's op graph for one static build, in execution order.
 
     Static config flags decide op *presence* (a disabled op is absent from
@@ -122,14 +172,47 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
     ``cfg.join_broadcast_enabled`` gates the whole join plane,
     ``cfg.faithful_failed_broadcast`` gates the intended-semantics Failed
     delivery, ``telemetry`` gates the counter reductions.
+
+    ``layout`` selects the plane format.  A ``blocked_topk`` build keeps the
+    same op vocabulary (fates per op in each ``sparse=`` declaration), adds
+    the ``block_repair`` tail op (the bounded per-tick neighbor-block edit
+    pass), and rejects configs needing dense-only planes: the join
+    broadcast has no domain in a blocked world, the intended-semantics
+    failed broadcast and non-faithful indirect-ack attribution are [N, N]
+    re-expressions no blocked kernel implements, and the telemetry counter
+    plane is dense-only today.
     """
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown layout {layout!r} (expected one of {LAYOUTS})")
+    if layout == "blocked_topk":
+        if cfg.join_broadcast_enabled:
+            raise ValueError(
+                "blocked_topk: no broadcast domain — build the config with "
+                "join_broadcast_enabled=False (ring-contact gossip boot "
+                "replaces the join broadcast)"
+            )
+        if not cfg.faithful_failed_broadcast:
+            raise ValueError(
+                "blocked_topk requires faithful_failed_broadcast=True: the "
+                "intended-semantics Failed replay is an [N, N, N] dense op"
+            )
+        if not cfg.faithful_indirect_ack:
+            raise ValueError(
+                "blocked_topk requires faithful_indirect_ack=True: only the "
+                "faithful proxy-attribution (quirk Q11) has a blocked twin"
+            )
+        if telemetry:
+            raise ValueError(
+                "blocked_topk has no telemetry counter plane yet — build "
+                "with telemetry=False"
+            )
     ops: list[PhaseOp] = [
         _op(
             "rng_split", "-",
             "Counter-based PRNG: split(key, 5) -> (proxy, ping, bern, drop, "
             "next); the carried key is row 4 whatever happens this tick.",
             "prologue", reads=("key",), writes=("key",), gives=("keys",),
-            span="live",
+            span="live", sparse="row",
         ),
     ]
     if faulty:
@@ -141,7 +224,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             reads=("alive", "S", "T", "lat", "idv", "identity", "never_b", "tick"),
             writes=("alive", "S", "T", "lat", "idv", "never_b"),
             inputs=("kill", "revive"), gives=("rv",),
-            activity="any kill/revive scheduled this tick",
+            activity="any kill/revive scheduled this tick", sparse="row",
         ))
     ops.append(_op(
         "delivery_gate", "-",
@@ -161,7 +244,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         "read of (S, T); also the raw material of the dispatch predicate.",
         "prologue", reads=("S", "T", "alive", "tick"),
         gives=("row_count0", "has_timed", "wfip_any", "any_a2"),
-        span="invariant",
+        span="invariant", sparse="block",
     ))
     if cfg.join_broadcast_enabled:
         ops.append(_op(
@@ -193,7 +276,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         takes=("keys", "has_timed", "wfip_any"),
         gives=("escalate", "insta_remove", "jstar", "proxies", "any_rem"),
         activity="any_a2: a timed-out suspicion exists", pred_term="any_a2",
-        mask_rank=2, span="invariant", sig_term="any_a2",
+        mask_rank=2, span="invariant", sig_term="any_a2", sparse="block",
     ))
     ops.append(_op(
         "probe_draw", "A3",
@@ -201,7 +284,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         "the target cell arms WaitingForPing(now).",
         "tail", reads=("S", "T", "alive"), writes=("S", "T"),
         takes=("keys",), gives=("ping_tgt", "has_ping"),
-        span="live", cut="A",
+        span="live", cut="A", sparse="block",
     ))
     if cfg.join_broadcast_enabled:
         ops.append(_op(
@@ -248,7 +331,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
                "escalate", "jstar"),
         gives=("mark1", "ok_ping", "ok_man", "del_ack", "del_ack_man",
                "del_pr", "del_pping", "fp1", "n1"),
-        span="degenerate", cut="c1",
+        span="degenerate", cut="c1", sparse="block",
     ))
     ops.append(_op(
         "call2", "2",
@@ -260,7 +343,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
                "proxies", "escalate", "jstar", "ok")
         + (("reply_del", "gossip", "any_join") if cfg.join_broadcast_enabled else ()),
         gives=("fp2", "n2", "dfp2", "dn2"),
-        span="degenerate", cut="c2",
+        span="degenerate", cut="c2", sparse="block",
     ))
     ops.append(_op(
         "calls34", "34",
@@ -274,6 +357,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         gives=("del_pack", "fwd", "fwd_c", "del_fwd", "del_fwd_c"),
         activity="any escalation this tick", pred_term="any_a2",
         mask_rank=2, span="invariant", sig_term="any_a2", cut="c34",
+        sparse="block",
     ))
     ops.append(_op(
         "anti_entropy", "G",
@@ -292,7 +376,7 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         gives=("partner", "del_kpr", "del_rep", "fp_g", "n_g", "fp_f",
                "n_f", "ae_records"),
         activity="fingerprints disagree somewhere", span="degenerate",
-        hybrid="sterile", cut="G",
+        hybrid="sterile", cut="G", sparse="block",
     ))
     if telemetry:
         ops.append(_op(
@@ -312,6 +396,17 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
             gives=("counters",),
             span="degenerate",
         ))
+    if layout == "blocked_topk":
+        ops.append(_op(
+            "block_repair", "-",
+            "Bounded per-tick neighbor-block edits: the tick's insert "
+            "candidates (ping sender-marks + gossip shares) fold into empty "
+            "slots via rank-matched placement — static [N, C, K] shapes, so "
+            "violent churn never recompiles (sparseplane/repair.py).",
+            "tail", reads=("S", "T", "alive"), writes=("S", "T"),
+            takes=("mark1", "ae_records"),
+            activity="any insert candidate this tick", sparse="block",
+        ))
     ops.append(_op(
         "finish", "-",
         "Metrics + next-state assembly: fingerprint agreement, mean "
@@ -322,6 +417,6 @@ def op_table(cfg, faulty: bool = True, telemetry: bool = False) -> tuple[PhaseOp
         takes=("fp_f", "n_f", "del_kpr", "partner", "fp_g", "n_g")
         + (("counters",) if telemetry else ()),
         gives=("metrics",),
-        span="degenerate",
+        span="degenerate", sparse="row",
     ))
     return tuple(ops)

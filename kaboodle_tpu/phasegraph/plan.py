@@ -33,6 +33,12 @@ executes:
   hybrid program is a strict superset that degenerates bit-exactly.
 - ``blocked`` — the chunked derivation: the full pass order, each [N, N]
   pass re-expressed as a ``lax.map`` over row blocks (layout, not logic).
+- ``sparse`` — the blocked_topk-layout derivation: dense-only ops (sparse
+  fate ``absent``) are pruned with reasons, the surviving tail ops group
+  into the six sparse passes (expiry / draw / exchange / gossip / repair /
+  finish) the [N, K] kernel executes (sparseplane/kernel.py). Requires a
+  graph built with ``layout="blocked_topk"``; conversely every other mode
+  requires the dense layout.
 
 The executable engines assemble themselves FROM these programs (exec.py
 iterates the planned passes; derive.py builds all five engines), so op
@@ -47,7 +53,7 @@ import dataclasses
 from kaboodle_tpu.phasegraph.graph import GraphError, TickGraph
 from kaboodle_tpu.phasegraph.ops import PhaseOp
 
-MODES = ("full", "fused", "span", "blocked", "hybrid")
+MODES = ("full", "fused", "span", "blocked", "hybrid", "sparse")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,14 +245,80 @@ def _plan_blocked(graph: TickGraph) -> TickProgram:
     return dataclasses.replace(full, mode="blocked")
 
 
+# Per-op pruning reasons for dense-only ops in a blocked_topk graph; ops
+# not listed fall back to the generic reason.
+_SPARSE_PRUNE_REASONS = {
+    "delivery_gate": "counter-draw bernoullis replace the materialized [N, N] gate",
+    "manual_targets": "manual pings have no blocked surface yet",
+}
+
+# Tail pass each sparse-surviving op belongs to, in kernel execution order.
+# A new tail op declared sparse != "absent" MUST be added here — the
+# planner refuses to guess, so kernel/plan drift is a build error.
+_SPARSE_TAIL_GROUPS = (
+    ("expiry", ("suspicion",)),
+    ("draw", ("probe_draw",)),
+    ("exchange", ("call1", "call2", "calls34")),
+    ("gossip", ("anti_entropy",)),
+    ("repair", ("block_repair",)),
+    ("finish", ("finish",)),
+)
+
+
+def _plan_sparse(graph: TickGraph) -> TickProgram:
+    if graph.layout != "blocked_topk":
+        raise GraphError(
+            "sparse programs derive from blocked_topk graphs: "
+            "build_graph(cfg, layout='blocked_topk')"
+        )
+    pruned: list[tuple[str, str]] = []
+    prologue: list[PhaseOp] = []
+    group_of = {op: name for name, ops in _SPARSE_TAIL_GROUPS for op in ops}
+    grouped: dict[str, list[PhaseOp]] = {name: [] for name, _ in _SPARSE_TAIL_GROUPS}
+    for op in graph.ops:
+        if op.sparse == "absent":
+            pruned.append((
+                op.name,
+                _SPARSE_PRUNE_REASONS.get(
+                    op.name, "dense-only op (sparse fate 'absent')"
+                ),
+            ))
+        elif op.stage == "prologue":
+            prologue.append(op)
+        elif op.name not in group_of:
+            raise GraphError(
+                f"{op.name}: sparse fate {op.sparse!r} but no sparse tail "
+                "pass claims it (_SPARSE_TAIL_GROUPS)"
+            )
+        else:
+            grouped[group_of[op.name]].append(op)
+    tail = tuple(
+        Pass(name, tuple(grouped[name]))
+        for name, _ in _SPARSE_TAIL_GROUPS
+        if grouped[name]
+    )
+    return TickProgram(
+        mode="sparse",
+        prologue=_single_passes(prologue),
+        tail=tail,
+        pruned=tuple(pruned),
+    )
+
+
 def plan(graph: TickGraph, mode: str) -> TickProgram:
     """Compose ``graph`` into the given engine mode's program."""
     if mode not in MODES:
         raise ValueError(f"unknown plan mode {mode!r} (expected one of {MODES})")
+    if mode != "sparse" and graph.layout != "dense":
+        raise GraphError(
+            f"{mode} programs derive from dense-layout graphs; a "
+            "blocked_topk graph plans only with mode='sparse'"
+        )
     return {
         "full": _plan_full,
         "fused": _plan_fused,
         "span": _plan_span,
         "blocked": _plan_blocked,
         "hybrid": _plan_hybrid,
+        "sparse": _plan_sparse,
     }[mode](graph)

@@ -1,0 +1,40 @@
+"""Blocked-sparse membership planes for million-peer worlds.
+
+The dense engines keep the bit-exact ``[N, N]`` formulation; this package
+holds its ``blocked_topk`` twin: each row's membership view lives in a
+``[N, K]`` top-K-neighbor block (int32 neighbor-index plane + int8 state
+plane + timer plane), and every uniform draw is counter-based threefry
+keyed ``(seed, cursor, stream, row, slot)`` so no ``[N, N]`` tensor is ever
+materialized.  The tick kernel is derived from the same phasegraph op
+table as the dense engines (``build_graph(..., layout="blocked_topk")`` +
+``plan(graph, "sparse")``); parity with the dense oracle is pinned on
+distribution statistics, not bits (tests/test_fuzz_parity.py).
+"""
+
+from kaboodle_tpu.sparseplane.state import (
+    SparseSpec,
+    SparseState,
+    SparseTickInputs,
+    SparseTickMetrics,
+    init_sparse_state,
+    sparse_idle_inputs,
+    sparse_fingerprint,
+)
+from kaboodle_tpu.sparseplane.kernel import make_sparse_tick_fn
+from kaboodle_tpu.sparseplane.runner import (
+    simulate_sparse,
+    run_sparse_until_converged,
+)
+
+__all__ = [
+    "SparseSpec",
+    "SparseState",
+    "SparseTickInputs",
+    "SparseTickMetrics",
+    "init_sparse_state",
+    "sparse_idle_inputs",
+    "sparse_fingerprint",
+    "make_sparse_tick_fn",
+    "simulate_sparse",
+    "run_sparse_until_converged",
+]

@@ -1,0 +1,143 @@
+"""``bench.py --sparse`` — the million-peer bench, banks BENCH_sparse.json.
+
+The ISSUE 18 acceptance run: boot N >= 1,000,000 peers single-host in the
+blocked_topk layout, advance real ticks, and bank:
+
+- **per-peer cost** — seconds/tick and ns/peer/tick over warmed steady
+  chunks, with ``compiles_steady`` counted across the timed window (the
+  zero-recompile gate, same counter as KB405);
+- **convergence curves** — block_fill and mean_membership per banked
+  chunk boundary from the cold boot (at K << N the mesh converges to
+  full blocks and a full alive count, not to fingerprint agreement — the
+  full-agreement predicate is the toy-N stat lane's job);
+- **sub-quadratic evidence** — AOT bytes-accessed of the same steady tick
+  at N=1024 vs N=8192 (an 8x N step): the ratio must sit far below the
+  dense 64x, and is banked next to the costscope registry entries
+  (phasegraph.tick.sparse) that gate it per-commit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _tick_bytes(cfg, spec, n: int) -> int:
+    import jax
+
+    from kaboodle_tpu.sparseplane import (
+        init_sparse_state,
+        make_sparse_tick_fn,
+        sparse_idle_inputs,
+    )
+
+    comp = (
+        jax.jit(make_sparse_tick_fn(cfg, spec))
+        .lower(init_sparse_state(n, spec, seed=0), sparse_idle_inputs(n))
+        .compile()
+    )
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return int(ca.get("bytes accessed", 0))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench.py --sparse",
+        description="million-peer blocked_topk bench; writes BENCH_sparse.json",
+    )
+    p.add_argument("--n", type=int, default=1 << 20,
+                   help="mesh size (default: 2^20 = 1,048,576 peers)")
+    p.add_argument("--k", type=int, default=16, help="block width K")
+    p.add_argument("--boot", type=int, default=3, help="boot ring contacts")
+    p.add_argument("--ticks", type=int, default=24,
+                   help="total ticks from boot (banked in chunks)")
+    p.add_argument("--chunk", type=int, default=8,
+                   help="scan chunk length (one compiled program)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="BENCH_sparse.json")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sparseplane import (
+        SparseSpec,
+        init_sparse_state,
+        simulate_sparse,
+        sparse_idle_inputs,
+    )
+
+    assert_counter_live()
+    cfg = SwimConfig(join_broadcast_enabled=False)
+    spec = SparseSpec(k=args.k, gossip_fanout=4, boot_contacts=args.boot)
+    n, chunk = args.n, args.chunk
+    chunks = max(args.ticks // chunk, 2)
+
+    print(f"sparse-bench: boot n={n} k={spec.k} ({chunks}x{chunk} ticks)")
+    st = init_sparse_state(n, spec, seed=args.seed)
+    inp = sparse_idle_inputs(n, ticks=chunk)
+
+    curve = []
+    times = []
+    compiles_steady = 0
+    for c in range(chunks):
+        t0 = time.perf_counter()
+        if c == 0:
+            # chunk 0 pays the compile; everything after is the steady
+            # window and must compile nothing
+            st, m = simulate_sparse(st, inp, cfg, spec)
+            jax.block_until_ready(st.nbr_idx)
+        else:
+            with compile_counter() as box:
+                st, m = simulate_sparse(st, inp, cfg, spec)
+                jax.block_until_ready(st.nbr_idx)
+            compiles_steady += box.count
+            times.append(time.perf_counter() - t0)
+        curve.append({
+            "tick": int(st.tick),
+            "block_fill": float(np.asarray(m.block_fill)[-1]),
+            "mean_membership": float(np.asarray(m.mean_membership)[-1]),
+        })
+        print(f"sparse-bench: tick {int(st.tick):4d} "
+              f"fill={curve[-1]['block_fill']:.3f} "
+              f"({time.perf_counter() - t0:.1f}s)")
+
+    s_per_tick = sum(times) / (len(times) * chunk)
+    small, big = _tick_bytes(cfg, spec, 1024), _tick_bytes(cfg, spec, 8192)
+    record = {
+        "metric": "sparse_bench",
+        "n": n, "k": spec.k, "boot_contacts": args.boot,
+        "ticks": chunks * chunk, "chunk": chunk, "seed": args.seed,
+        "s_per_tick": s_per_tick,
+        "ns_per_peer_tick": 1e9 * s_per_tick / n,
+        "compiles_steady": compiles_steady,
+        "curve": curve,
+        "sub_quadratic": {
+            "bytes_accessed_n1024": small,
+            "bytes_accessed_n8192": big,
+            "ratio_8x_n": big / max(small, 1),
+            "dense_ratio_would_be": 64.0,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(f"sparse-bench: {s_per_tick * 1e3:.0f} ms/tick "
+          f"({record['ns_per_peer_tick']:.0f} ns/peer), "
+          f"compiles_steady={compiles_steady}, "
+          f"bytes ratio {record['sub_quadratic']['ratio_8x_n']:.1f}x "
+          f"-> {args.out}")
+    return 0 if compiles_steady == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""``python -m kaboodle_tpu sparse --dryrun`` — the sparseplane CI lane.
+
+Two legs, seconds-to-minutes on CPU:
+
+1. **Toy-N stat check** — the blocked_topk engine against the dense oracle
+   on a matched-seed full-view boot (k >= n-1, so "converged" is the same
+   fingerprint-agreement predicate the dense runner tests): both arms must
+   converge, the sparse convergence tick must sit in the calibrated band
+   around the dense one, the converged steady tick must emit exactly the
+   dense steady counter means (n pings, 2n delivered, agreement 1.0), and
+   a warmed steady window must compile NOTHING fresh.
+
+2. **Capped million-peer smoke** — boot N=2^20 peers (or ``--smoke-n``),
+   run a few real ticks, and report per-peer per-tick cost; the smoke
+   proves the [N, K] layout actually holds a million-peer world in memory
+   and advances it, not just that the program traces. ``--skip-smoke``
+   drops this leg for fast local iteration.
+
+The at-scale numbers (longer runs, convergence curves, banked JSON) live
+in ``bench.py --sparse`` / BENCH_sparse.json; this is the wiring gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _stat_check(seed: int) -> dict:
+    import numpy as np
+
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sim.runner import run_until_converged
+    from kaboodle_tpu.sim.state import init_state
+    from kaboodle_tpu.sparseplane import (
+        SparseSpec,
+        init_sparse_state,
+        run_sparse_until_converged,
+        simulate_sparse,
+        sparse_idle_inputs,
+    )
+
+    assert_counter_live()
+    n, boot = 24, 2
+    cfg = SwimConfig(join_broadcast_enabled=False)
+    spec = SparseSpec(k=32, gossip_fanout=4, boot_contacts=boot)
+
+    _, dticks, dconv = run_until_converged(
+        init_state(n, seed=seed, ring_contacts=boot), cfg, max_ticks=96
+    )
+    sst = init_sparse_state(n, spec, seed=seed)
+    fin, sticks, sconv = run_sparse_until_converged(
+        sst, cfg, spec, max_ticks=96
+    )
+    d, s = int(dticks), int(sticks)
+    checks = {
+        "dense_converged": bool(dconv),
+        "sparse_converged": bool(sconv),
+        # the calibrated band: empirically ~2.1x at gossip_fanout=4
+        "band": bool(dconv) and bool(sconv) and d // 2 <= s <= 4 * d + 10,
+    }
+
+    # steady counter means from the converged mesh, zero drops
+    _, m = simulate_sparse(fin, sparse_idle_inputs(n, ticks=8), cfg, spec)
+    checks["steady_pings"] = bool((np.asarray(m.pings_sent) == n).all())
+    checks["steady_delivered"] = bool(
+        (np.asarray(m.messages_delivered) == 2 * n).all()
+    )
+    checks["steady_agreement"] = bool(
+        (np.asarray(m.agree_fraction) == 1.0).all()
+    )
+
+    # zero fresh compiles re-dispatching the warmed steady window
+    with compile_counter() as box:
+        simulate_sparse(fin, sparse_idle_inputs(n, ticks=8), cfg, spec)
+    checks["compiles_steady_zero"] = box.count == 0
+
+    return {
+        "n": n, "k": spec.k, "dense_ticks": d, "sparse_ticks": s,
+        "compiles_steady": box.count, "checks": checks,
+    }
+
+
+def _smoke(n: int, ticks: int, seed: int) -> dict:
+    import jax
+
+    from kaboodle_tpu.config import SwimConfig
+    from kaboodle_tpu.sparseplane import (
+        SparseSpec,
+        init_sparse_state,
+        simulate_sparse,
+        sparse_idle_inputs,
+    )
+
+    cfg = SwimConfig(join_broadcast_enabled=False)
+    spec = SparseSpec(k=16, gossip_fanout=4, boot_contacts=3)
+    st = init_sparse_state(n, spec, seed=seed)
+    inp = sparse_idle_inputs(n, ticks=ticks)
+    # compile + one warm pass, then the timed pass
+    st2, _ = simulate_sparse(st, inp, cfg, spec)
+    jax.block_until_ready(st2.nbr_idx)
+    t0 = time.perf_counter()
+    st3, m = simulate_sparse(st2, inp, cfg, spec)
+    jax.block_until_ready(st3.nbr_idx)
+    dt = time.perf_counter() - t0
+    import numpy as np
+
+    return {
+        "n": n, "k": spec.k, "ticks": ticks,
+        "s_per_tick": dt / ticks,
+        "ns_per_peer_tick": 1e9 * dt / ticks / n,
+        "block_fill": float(np.asarray(m.block_fill)[-1]),
+        "advanced": int(st3.tick) == 2 * ticks,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kaboodle_tpu sparse",
+        description="sparseplane dryrun: toy-N stat check vs the dense "
+                    "oracle + capped million-peer smoke",
+    )
+    p.add_argument("--dryrun", action="store_true",
+                   help="accepted for symmetry with the other CI lanes "
+                        "(this tool IS the dryrun)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke-n", type=int, default=1 << 20,
+                   help="smoke mesh size (default: 2^20 peers)")
+    p.add_argument("--smoke-ticks", type=int, default=4,
+                   help="timed smoke ticks after one warm pass")
+    p.add_argument("--skip-smoke", action="store_true",
+                   help="stat check only (fast local iteration)")
+    args = p.parse_args(argv)
+
+    stat = _stat_check(args.seed)
+    ok = all(stat["checks"].values())
+    for name, good in stat["checks"].items():
+        print(f"sparse: {name:22s} {'ok' if good else 'FAIL'}")
+    print(f"sparse: convergence dense={stat['dense_ticks']} "
+          f"sparse={stat['sparse_ticks']} ticks")
+
+    out = {"metric": "sparse_dryrun", "stat": stat}
+    if not args.skip_smoke:
+        smoke = _smoke(args.smoke_n, args.smoke_ticks, args.seed)
+        ok = ok and smoke["advanced"]
+        out["smoke"] = smoke
+        print(f"sparse: smoke n={smoke['n']} "
+              f"{smoke['s_per_tick'] * 1e3:.0f} ms/tick "
+              f"({smoke['ns_per_peer_tick']:.0f} ns/peer), "
+              f"fill {smoke['block_fill']:.3f}")
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,333 @@
+"""The blocked-sparse SWIM tick: segment gather/scatter over ``[N, K]`` blocks.
+
+Derived from the same phasegraph op table as the dense engines
+(``build_graph(cfg, layout="blocked_topk")`` + ``plan(graph, "sparse")``);
+the tail pass grouping below mirrors the planner's output one-to-one and is
+pinned against it in tests/test_sparseplane.py:
+
+  expiry    suspicion twin: WaitingForIndirectPing slots age out, the oldest
+            timed-out WaitingForPing slot per row escalates to an indirect
+            ping chain over counter-drawn proxy slots.
+  draw      probe-draw twin: uniform pick among the oldest-k Known slots
+            (the same ``choose_one_of_oldest_k`` primitive the dense kernel
+            uses, over ``[N, K]`` instead of ``[N, N]``).
+  exchange  ping/ack delivery: the ack refreshes the armed slot; the ping
+            sender-marks the sender inside the *target's* block (the one
+            cross-row scatter of the tick, conflict-free by slot identity).
+  gossip    anti-entropy twin: each delivered ack piggybacks
+            ``gossip_fanout`` random sharable records from the target's
+            block (Known, heard strictly within MAX_PEER_SHARE_AGE — the
+            dense reply filter verbatim).
+  repair    bounded block edits (sparseplane/repair.py): fold the tick's
+            insert candidates into empty slots, static shapes only.
+  finish    fingerprint + metrics + counter advance.
+
+Every uniform is a counter-threefry draw keyed ``(seed, cursor, stream)``
+with the element position supplying ``(row, slot)`` — no ``[N, N]`` tensor
+exists anywhere in the tick (sparseplane/rng.py).
+
+Semantics match the dense oracle distributionally, not bitwise; the known
+deviations are bounded and documented here so the stat-pin harness
+(tests/test_fuzz_parity.py) is comparing what it thinks it is:
+
+- proxy picks draw with replacement (dense: distinct Gumbel-top-k) — only
+  distinguishable when a row knows fewer than ``num_indirect_ping_peers``
+  live peers;
+- the ping-req leg does not sender-mark the requester at the proxy (a
+  secondary dense spread path; gossip piggyback dominates it);
+- at most one ping sender-mark *insert* lands per receiver per tick (the
+  dense kernel can absorb one per sender) — extra senders retry next tick;
+- revived rows re-enter via ring boot contacts instead of the join
+  broadcast, which has no domain in a blocked world.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import fingerprint_agreement
+from kaboodle_tpu.ops.sampling import choose_one_of_oldest_k
+from kaboodle_tpu.sparseplane import rng as sprng
+from kaboodle_tpu.sparseplane.repair import repair_blocks, reseed_revived
+from kaboodle_tpu.sparseplane.state import (
+    SparseSpec,
+    SparseState,
+    SparseTickInputs,
+    SparseTickMetrics,
+    sparse_fingerprint,
+)
+from kaboodle_tpu.spec import (
+    KNOWN,
+    WAITING_FOR_INDIRECT_PING,
+    WAITING_FOR_PING,
+)
+
+# The planner's tail grouping for mode="sparse" — kept here so the kernel
+# and plan.py can never drift silently (pinned in tests/test_sparseplane.py).
+SPARSE_TAIL_PASSES = ("expiry", "draw", "exchange", "gossip", "repair", "finish")
+
+
+def _validate(cfg: SwimConfig) -> None:
+    if cfg.join_broadcast_enabled:
+        raise ValueError(
+            "blocked_topk layout has no broadcast domain: build the config "
+            "with join_broadcast_enabled=False (gossip boot via ring "
+            "contacts replaces the join broadcast)"
+        )
+    if not cfg.faithful_failed_broadcast:
+        raise ValueError(
+            "intended-semantics failed-broadcast replay is dense-only "
+            "([N, N, N] delivery replay); blocked_topk requires "
+            "faithful_failed_broadcast=True"
+        )
+    if not cfg.faithful_indirect_ack:
+        raise ValueError(
+            "blocked_topk implements only the faithful indirect-ack "
+            "attribution (forwarded ack refreshes the proxy, quirk Q11); "
+            "set faithful_indirect_ack=True"
+        )
+
+
+def _rank_pick(mask: jax.Array, want: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Slot of the ``want``-th True per row: mask ``[N, K]``, want ``[N, D]``.
+
+    Returns ``(slot [N, D] int32, ok [N, D] bool)`` — ``ok`` is False where
+    the requested rank exceeds the row's population (which is also how the
+    deterministic arange-ranks mode degrades to "first min(D, count)").
+    """
+    rank = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1  # [N, K]
+    sel = mask[:, None, :] & (rank[:, None, :] == want[:, :, None])  # [N, D, K]
+    slot = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+    ok = jnp.any(sel, axis=-1)
+    return slot, ok
+
+
+def make_sparse_tick_fn(
+    cfg: SwimConfig, spec: SparseSpec, faulty: bool = True
+):
+    """Build the blocked-sparse tick: ``(SparseState, SparseTickInputs) ->
+    (SparseState, SparseTickMetrics)``.  cfg/spec are static (hashable)."""
+    _validate(cfg)
+    timeout = int(cfg.ping_timeout_ticks)
+    share_age = int(cfg.max_peer_share_age_ticks)
+    n_proxy = int(cfg.num_indirect_ping_peers)
+    kc = int(cfg.num_candidate_target_peers)
+    g = int(spec.gossip_fanout)
+    det = bool(cfg.deterministic)
+    backdate = share_age if cfg.backdate_gossip_inserts else 0
+
+    # The closure is traced from ANOTHER module (runner's lax.scan /
+    # while_loop and the jax.jit call sites in tests), which per-module
+    # reachability can't see — the pragma keeps the KB2xx tracer rules live
+    # on the tick body without tainting the builder's static cfg/spec reads.
+    def tick(st: SparseState, inp: SparseTickInputs):  # graftlint: traced
+        n, k = st.nbr_idx.shape
+        tdt = st.nbr_timer.dtype
+        rows = jnp.arange(n, dtype=jnp.int32)
+        slots = jnp.arange(k, dtype=jnp.int32)
+        t32 = st.tick
+        now_t = t32.astype(tdt)
+        seed, cur = st.seed, st.cursor
+
+        nbr_idx, nbr_state, nbr_timer = st.nbr_idx, st.nbr_state, st.nbr_timer
+        alive = st.alive
+
+        # -- churn (prologue): alive flips; revived rows gossip-boot fresh.
+        if faulty:
+            revived = inp.revive & ~alive
+            alive = (alive | inp.revive) & ~inp.kill
+            nbr_idx, nbr_state, nbr_timer = reseed_revived(
+                nbr_idx, nbr_state, nbr_timer, revived, spec.boot_contacts, now_t
+            )
+            drop = inp.drop_rate
+        else:
+            drop = jnp.float32(0.0)
+
+        # -- expiry: age suspicion timers, escalate the oldest timed-out
+        # WaitingForPing slot per row through an indirect-ping chain.
+        age = t32 - nbr_timer.astype(jnp.int32)  # [N, K]
+        act = alive[:, None]
+        wfip_exp = (nbr_state == WAITING_FOR_INDIRECT_PING) & (age >= timeout) & act
+        wfp_timed = (nbr_state == WAITING_FOR_PING) & (age >= timeout) & act
+
+        esc_score = jnp.where(wfp_timed, age, jnp.int32(-(1 << 30)))
+        esc_slot = jnp.argmax(esc_score, axis=1).astype(jnp.int32)
+        has_timed = jnp.any(wfp_timed, axis=1)
+        esc_oh = (slots[None, :] == esc_slot[:, None]) & has_timed[:, None]
+
+        known = nbr_state == KNOWN
+        pcnt = jnp.sum(known, axis=1, dtype=jnp.int32)
+        escalate = has_timed & (pcnt > 0)
+        insta = has_timed & (pcnt == 0)  # no proxies: remove instantly
+
+        if det:
+            want_p = jnp.broadcast_to(
+                jnp.arange(n_proxy, dtype=jnp.int32)[None, :], (n, n_proxy)
+            )
+        else:
+            u_p = sprng.stream_uniform(seed, cur, sprng.STREAM_PROXY, (n, n_proxy))
+            want_p = jnp.clip(
+                jnp.floor(u_p * pcnt[:, None].astype(jnp.float32)).astype(jnp.int32),
+                0,
+                jnp.maximum(pcnt - 1, 0)[:, None],
+            )
+        pslot, p_ok = _rank_pick(known, want_p)  # [N, P]
+        pj = jnp.take_along_axis(nbr_idx, pslot, axis=1)
+        pj_c = jnp.clip(pj, 0, n - 1)
+        suspect = jnp.take_along_axis(nbr_idx, esc_slot[:, None], axis=1)[:, 0]
+        suspect_c = jnp.clip(suspect, 0, n - 1)
+
+        if faulty:
+            u_ch = sprng.stream_uniform(
+                seed, cur, sprng.STREAM_CHAIN, (n, n_proxy, 4)
+            )
+            legs = jnp.all(u_ch >= drop, axis=-1)  # all 4 unicast legs land
+        else:
+            legs = jnp.ones((n, n_proxy), bool)
+        chain_ok = escalate[:, None] & p_ok & legs & alive[pj_c] & alive[suspect_c][:, None]
+
+        remove = wfip_exp | (esc_oh & insta[:, None])
+        to_wfip = esc_oh & escalate[:, None]
+        # Faithful indirect-ack (quirk Q11): the forwarded ack refreshes the
+        # PROXY slot at the requester; the suspect stays WaitingForIndirectPing.
+        refresh_p = jnp.any(
+            (slots[None, None, :] == pslot[:, :, None]) & chain_ok[:, :, None],
+            axis=1,
+        )
+        nbr_state = jnp.where(remove, jnp.int8(0), nbr_state)
+        nbr_idx = jnp.where(remove, jnp.int32(-1), nbr_idx)
+        nbr_state = jnp.where(to_wfip, jnp.int8(WAITING_FOR_INDIRECT_PING), nbr_state)
+        nbr_timer = jnp.where(to_wfip, now_t, nbr_timer)
+        nbr_state = jnp.where(refresh_p, jnp.int8(KNOWN), nbr_state)
+        nbr_timer = jnp.where(refresh_p, now_t, nbr_timer)
+        chain_msgs = jnp.int32(4) * jnp.sum(chain_ok, dtype=jnp.int32)
+
+        # -- draw: ping target = uniform among the oldest-kc Known slots,
+        # the dense primitive applied to [N, K] scores.
+        known2 = nbr_state == KNOWN
+        tslot = choose_one_of_oldest_k(
+            nbr_timer,
+            known2,
+            kc,
+            sprng.stream_key(seed, cur, sprng.STREAM_DRAW),
+            deterministic=det,
+            method=cfg.oldest_k_method,
+        )
+        has_ping = alive & (tslot >= 0)
+        tslot_c = jnp.clip(tslot, 0, k - 1)
+        tgt = jnp.take_along_axis(nbr_idx, tslot_c[:, None], axis=1)[:, 0]
+        tgt_c = jnp.clip(tgt, 0, n - 1)
+        arm = (slots[None, :] == tslot_c[:, None]) & has_ping[:, None]
+        nbr_state = jnp.where(arm, jnp.int8(WAITING_FOR_PING), nbr_state)
+        nbr_timer = jnp.where(arm, now_t, nbr_timer)
+
+        # -- exchange: counter-draw bernoullis replace the dense [N, N]
+        # delivery gate; the ack closes the probe, the ping sender-marks.
+        if faulty:
+            u_ping = sprng.stream_uniform(seed, cur, sprng.STREAM_PING, (n,))
+            u_ack = sprng.stream_uniform(seed, cur, sprng.STREAM_ACK, (n,))
+            del_ping = has_ping & alive[tgt_c] & (u_ping >= drop)
+            del_ack = del_ping & (u_ack >= drop)
+        else:
+            del_ping = has_ping & alive[tgt_c]
+            del_ack = del_ping
+
+        ackref = arm & del_ack[:, None]
+        nbr_state = jnp.where(ackref, jnp.int8(KNOWN), nbr_state)
+        nbr_timer = jnp.where(ackref, now_t, nbr_timer)
+
+        # Sender-mark inside the target's block: slot identified by matching
+        # the sender id, so concurrent senders write disjoint (row, slot)
+        # pairs; undelivered pings are routed to row n and dropped.
+        blk_t = nbr_idx[tgt_c]  # [N, K] gather of target blocks
+        occ_t = nbr_state[tgt_c] > 0
+        eq = (blk_t == rows[:, None]) & occ_t
+        mfound = jnp.any(eq, axis=1)
+        mslot = jnp.argmax(eq, axis=1).astype(jnp.int32)
+        mark_rows = jnp.where(del_ping & mfound, tgt_c, jnp.int32(n))
+        nbr_state = nbr_state.at[mark_rows, mslot].set(jnp.int8(KNOWN), mode="drop")
+        nbr_timer = nbr_timer.at[mark_rows, mslot].set(now_t, mode="drop")
+
+        # Unknown sender: becomes an insert candidate at the receiver (max
+        # keeps exactly one per receiver per tick, deterministically).
+        pc_rows = jnp.where(del_ping & ~mfound, tgt_c, jnp.int32(n))
+        ping_cand = (
+            jnp.full((n,), -1, jnp.int32).at[pc_rows].max(rows, mode="drop")
+        )
+        exch_msgs = jnp.sum(del_ping, dtype=jnp.int32) + jnp.sum(
+            del_ack, dtype=jnp.int32
+        )
+
+        # -- gossip: each delivered ack piggybacks g random sharable records
+        # from the target's block (dense reply filter: Known, heard strictly
+        # within MAX_PEER_SHARE_AGE; self never in a block by invariant).
+        share_ok = (nbr_state == KNOWN) & (
+            (t32 - nbr_timer.astype(jnp.int32)) < share_age
+        )
+        srow = share_ok[tgt_c]  # [N, K] sharable mask of my ping target
+        scnt = jnp.sum(srow, axis=1, dtype=jnp.int32)
+        if det:
+            want_g = jnp.broadcast_to(jnp.arange(g, dtype=jnp.int32)[None, :], (n, g))
+        else:
+            u_g = sprng.stream_uniform(seed, cur, sprng.STREAM_GOSSIP, (n, g))
+            want_g = jnp.clip(
+                jnp.floor(u_g * scnt[:, None].astype(jnp.float32)).astype(jnp.int32),
+                0,
+                jnp.maximum(scnt - 1, 0)[:, None],
+            )
+        gslot, g_ok = _rank_pick(srow, want_g)
+        gcand = jnp.take_along_axis(nbr_idx[tgt_c], gslot, axis=1)
+        gcand = jnp.where(del_ack[:, None] & g_ok, gcand, jnp.int32(-1))
+
+        # -- repair: fold the tick's candidates into empty slots.  Ping
+        # sender-marks carry a fresh stamp and go first so they win dedup
+        # against the same peer arriving backdated via gossip.
+        cand = jnp.concatenate([ping_cand[:, None], gcand], axis=1)
+        gstamp = now_t - jnp.asarray(backdate, tdt)
+        stamps = jnp.concatenate(
+            [
+                jnp.broadcast_to(now_t, (n, 1)).astype(tdt),
+                jnp.broadcast_to(gstamp, (n, g)).astype(tdt),
+            ],
+            axis=1,
+        )
+        nbr_idx, nbr_state, nbr_timer = repair_blocks(
+            nbr_idx, nbr_state, nbr_timer, cand, stamps
+        )
+
+        # -- finish: fingerprint, agreement, counter advance.
+        new_st = SparseState(
+            nbr_idx=nbr_idx,
+            nbr_state=nbr_state,
+            nbr_timer=nbr_timer,
+            alive=alive,
+            identity=st.identity,
+            tick=t32 + 1,
+            seed=seed,
+            cursor=cur + jnp.uint32(1),
+        )
+        fp = sparse_fingerprint(new_st)
+        converged, fp_min, fp_max, n_alive = fingerprint_agreement(alive, fp)
+        agree = jnp.sum(alive & (fp == fp_min), dtype=jnp.int32)
+        occf = nbr_state > 0
+        mem = jnp.int32(1) + jnp.sum(occf, axis=1, dtype=jnp.int32)
+        denom = jnp.maximum(n_alive, 1)
+        metrics = SparseTickMetrics(
+            messages_delivered=exch_msgs + chain_msgs,
+            converged=converged,
+            agree_fraction=agree.astype(jnp.float32) / denom,
+            mean_membership=jnp.sum(jnp.where(alive, mem, 0).astype(jnp.float32))
+            / denom,
+            fingerprint_min=fp_min,
+            fingerprint_max=fp_max,
+            pings_sent=jnp.sum(has_ping, dtype=jnp.int32),
+            block_fill=jnp.sum(
+                jnp.where(alive[:, None], occf, False), dtype=jnp.float32
+            )
+            / (denom.astype(jnp.float32) * k),
+        )
+        return new_st, metrics
+
+    return tick

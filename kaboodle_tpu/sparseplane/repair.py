@@ -1,0 +1,124 @@
+"""Bounded per-tick neighbor-block repair.
+
+Membership churn in a blocked world is a block *edit* problem: gossip
+shares and ping sender-marks produce at most ``C = gossip_fanout + 1``
+insert candidates per row per tick, and dead peers free slots when the
+suspicion pass expires them.  This pass folds the candidate list into the
+block with static shapes only — candidate validation, in-block membership
+test, intra-list dedup, and rank-matched placement into empty slots are all
+fixed-``[N, C, K]`` tensor ops, so the steady tick stays a single compiled
+program (``compiles_steady=0``) no matter how violent the churn.
+
+Overflow policy: candidates beyond the free slots of a row are dropped on
+the floor.  SWIM re-offers membership continuously (every ack piggybacks a
+fresh share), so a dropped insert is retried by the protocol itself within
+a few ticks — bounding work per tick costs convergence latency, never
+correctness.  The stat-pin harness runs with ``K >= N - 1`` where no drop
+can occur, which is what makes the dense oracle comparison meaningful.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.spec import KNOWN
+
+
+def repair_blocks(  # graftlint: traced
+    nbr_idx: jax.Array,
+    nbr_state: jax.Array,
+    nbr_timer: jax.Array,
+    cand: jax.Array,
+    cand_stamp: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Insert up to ``C`` candidates per row into that row's empty slots.
+
+    ``cand`` is int32 ``[N, C]`` (``-1`` = no candidate), ``cand_stamp`` the
+    matching timer stamps in the block's timer dtype (gossip shares arrive
+    backdated per ``cfg.backdate_gossip_inserts``; ping sender-marks arrive
+    at ``now``).  Earlier columns win dedup ties — callers order candidates
+    by provenance priority.  Returns the edited ``(idx, state, timer)``.
+    """
+    n, k = nbr_idx.shape
+    c = cand.shape[1]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    occ = nbr_state > 0
+    valid = (cand >= 0) & (cand != rows[:, None])
+
+    # Already in the block?  [N, C, K] membership test against occupied slots.
+    in_block = jnp.any(
+        (cand[:, :, None] == nbr_idx[:, None, :]) & occ[:, None, :], axis=-1
+    )
+    valid &= ~in_block
+
+    # Intra-list dedup: a candidate loses to any identical valid candidate in
+    # an earlier column.  C is tiny (gossip_fanout + 1) so the static C^2/2
+    # compare loop beats a sort.
+    for j in range(1, c):
+        dup = jnp.zeros((n,), bool)
+        for i in range(j):
+            dup |= valid[:, i] & (cand[:, j] == cand[:, i])
+        valid = valid.at[:, j].set(valid[:, j] & ~dup)
+
+    # Rank-match placement: the r-th surviving candidate of a row fills the
+    # r-th empty slot of that row.  One-hot [N, C, K] product collapses to
+    # per-slot fills via a masked sum — conflict-free by construction since
+    # ranks are unique within a row.
+    cand_rank = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1  # [N, C]
+    empty = ~occ
+    slot_rank = jnp.cumsum(empty.astype(jnp.int32), axis=1) - 1  # [N, K]
+    place = (
+        valid[:, :, None]
+        & empty[:, None, :]
+        & (cand_rank[:, :, None] == slot_rank[:, None, :])
+    )  # [N, C, K]
+
+    filled = jnp.any(place, axis=1)  # [N, K]
+    new_idx = jnp.sum(
+        jnp.where(place, cand[:, :, None], 0), axis=1, dtype=jnp.int32
+    )
+    new_stamp = jnp.sum(
+        jnp.where(place, cand_stamp[:, :, None], 0),
+        axis=1,
+        dtype=nbr_timer.dtype,
+    )
+
+    nbr_idx = jnp.where(filled, new_idx, nbr_idx)
+    nbr_state = jnp.where(filled, jnp.int8(KNOWN), nbr_state)
+    nbr_timer = jnp.where(filled, new_stamp, nbr_timer)
+    return nbr_idx, nbr_state, nbr_timer
+
+
+def reseed_revived(  # graftlint: traced
+    nbr_idx: jax.Array,
+    nbr_state: jax.Array,
+    nbr_timer: jax.Array,
+    revived: jax.Array,
+    boot_contacts: int,
+    now_t: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Reset revived rows to fresh ring boot contacts.
+
+    The dense engines re-knit a revived peer via the join broadcast; a
+    blocked world has no broadcast domain, so revival re-enters through the
+    same gossip boot used at init: clear the block, seed ``boot_contacts``
+    ring neighbors at ``now``, and let ack piggybacking rebuild the view.
+    """
+    n, k = nbr_idx.shape
+    b = min(boot_contacts, n - 1, k)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    slots = jnp.arange(k, dtype=jnp.int32)
+    boot_col = slots[None, :] < b  # [1, K] static mask
+    ring = (rows[:, None] + 1 + slots[None, :]) % n
+
+    m = revived[:, None]
+    nbr_idx = jnp.where(m, jnp.where(boot_col, ring.astype(jnp.int32), -1), nbr_idx)
+    nbr_state = jnp.where(
+        m, jnp.where(boot_col, jnp.int8(KNOWN), jnp.int8(0)), nbr_state
+    )
+    nbr_timer = jnp.where(
+        m, jnp.where(boot_col, now_t, jnp.zeros((), nbr_timer.dtype)), nbr_timer
+    )
+    return nbr_idx, nbr_state, nbr_timer

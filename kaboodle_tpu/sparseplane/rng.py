@@ -1,0 +1,47 @@
+"""Counter-based threefry streams for the blocked-sparse tick.
+
+The dense engines carry a threefry key through the state and split it each
+tick; the blocked layout instead derives every draw on the fly from the
+``(seed, cursor)`` counter pair stored in ``SparseState``:
+
+    key(stream) = fold_in(fold_in(PRNGKey(seed), cursor), stream)
+
+and then takes a *shaped* uniform from that key, so the element position
+inside the draw supplies the remaining counter words — a ``(N, K)`` draw is
+effectively keyed ``(seed, tick, stream, row, slot)``.  Nothing ``[N, N]``
+is ever materialized, draws are reproducible from the checkpointable
+``cursor`` alone, and distinct ``STREAM_*`` ids keep the per-phase draws
+independent (no key reuse across phases — the same discipline KB204
+enforces on the dense engines).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# One id per randomized phase of the sparse tick, in tick order.  New phases
+# append — renumbering changes every draw of every banked run.
+STREAM_PROXY = 0  # proxy slot picks for ping-req fan-out
+STREAM_CHAIN = 1  # the four delivery legs of each indirect-ping chain
+STREAM_DRAW = 2  # ping target pick among the oldest-k Known slots
+STREAM_PING = 3  # direct ping delivery bernoulli
+STREAM_ACK = 4  # ack delivery bernoulli
+STREAM_GOSSIP = 5  # piggyback share slot picks
+
+
+def stream_key(seed: jax.Array, cursor: jax.Array, stream: int) -> jax.Array:
+    """Threefry key for one phase of one tick — pure function of the counters."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+    return jax.random.fold_in(base, jnp.uint32(stream))
+
+
+def stream_uniform(
+    seed: jax.Array, cursor: jax.Array, stream: int, shape: tuple[int, ...]
+) -> jax.Array:
+    """Shaped float32 uniform in [0, 1) for one phase (position = row/slot)."""
+    # f32 pinned: draw values feed thresholds and floor(u * count) index
+    # math where f64 would shift pick boundaries (same pin as ops/sampling).
+    return jax.random.uniform(
+        stream_key(seed, cursor, stream), shape, dtype=jnp.float32
+    )

@@ -1,0 +1,76 @@
+"""Scan and while-loop drivers for the blocked-sparse tick.
+
+Shapes mirror ``sim/runner.py``: ``simulate_sparse`` scans a scenario with a
+leading ticks axis; ``run_sparse_until_converged`` drives a fault-free mesh
+to fingerprint agreement under a while_loop (only meaningful when the block
+width can hold the full view, ``k >= n - 1`` — the stat-pin configuration).
+Both are jitted with cfg/spec static, so a warmed call re-dispatches with
+zero compiles — the ``compiles_steady=0`` surface the KB405 exercise and
+the fuzz harness pin.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kaboodle_tpu.config import SwimConfig
+from kaboodle_tpu.ops.hashing import fingerprint_agreement
+from kaboodle_tpu.sparseplane.kernel import make_sparse_tick_fn
+from kaboodle_tpu.sparseplane.state import (
+    SparseSpec,
+    SparseState,
+    SparseTickInputs,
+    sparse_fingerprint,
+    sparse_idle_inputs,
+)
+
+
+def sparse_converged(state: SparseState) -> jax.Array:
+    """Alive rows agree on one membership fingerprint (scalar bool)."""
+    converged, _, _, _ = fingerprint_agreement(
+        state.alive, sparse_fingerprint(state)
+    )
+    return converged
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "spec", "faulty"))
+def simulate_sparse(  # graftlint: traced
+    state: SparseState,
+    inputs: SparseTickInputs,
+    cfg: SwimConfig,
+    spec: SparseSpec,
+    faulty: bool = True,
+):
+    """Scan the sparse tick over a scenario with a leading ticks axis."""
+    tick = make_sparse_tick_fn(cfg, spec, faulty)
+    return jax.lax.scan(tick, state, inputs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "spec", "max_ticks"))
+def run_sparse_until_converged(  # graftlint: traced
+    state: SparseState, cfg: SwimConfig, spec: SparseSpec, max_ticks: int
+):
+    """Idle-tick a fault-free mesh until fingerprint agreement.
+
+    Returns ``(state, ticks_run, converged)`` like ``sim.runner
+    .run_until_converged``; a mesh converged at entry runs zero ticks.
+    """
+    tick = make_sparse_tick_fn(cfg, spec, faulty=False)
+    idle = sparse_idle_inputs(state.n)
+
+    def cond(carry):
+        st, ticks = carry
+        return (~sparse_converged(st)) & (ticks < max_ticks)
+
+    def body(carry):
+        st, ticks = carry
+        st2, _ = tick(st, idle)
+        return st2, ticks + jnp.int32(1)
+
+    st, ticks = jax.lax.while_loop(
+        cond, body, (state, jnp.zeros((), jnp.int32))
+    )
+    return st, ticks, sparse_converged(st)

@@ -1,0 +1,204 @@
+"""Blocked-sparse mesh state: ``[N, K]`` neighbor blocks instead of ``[N, N]``.
+
+Layout contract (the ``blocked_topk`` plane layout declared in
+``phasegraph/ops.py``):
+
+- ``nbr_idx``   int32 ``[N, K]`` — peer id per slot, ``-1`` for an empty slot.
+- ``nbr_state`` int8  ``[N, K]`` — spec state code per slot (``0`` = empty,
+  otherwise the same codes the dense ``state`` plane uses: Known /
+  WaitingForPing / WaitingForIndirectPing).
+- ``nbr_timer`` int32 or int16 ``[N, K]`` — last-heard tick per slot, the
+  blocked twin of the dense ``timer`` plane (same lean-int16 option).
+- ``seed`` / ``cursor`` uint32 scalars — the counter-RNG plane replacing the
+  dense threefry ``key``: every draw is re-derived from
+  ``fold_in(fold_in(PRNGKey(seed), cursor), stream)`` and the element
+  position inside the shaped draw encodes ``(row, slot)``, so randomness is
+  keyed ``(seed, tick, row, slot)`` without materializing ``[N, N]``.
+
+Row ``i``'s membership view is ``{i} ∪ occupied slots`` — self is implicit,
+mirroring the dense diagonal.  The fingerprint of a row is therefore the
+same commutative ``peer_record_hash`` sum the dense plane computes, and
+``fingerprint_agreement`` is shared verbatim with the dense engines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.ops.hashing import peer_record_hash
+from kaboodle_tpu.spec import KNOWN
+
+_TIMER_DTYPES = ("int32", "int16")
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseSpec:
+    """Static knobs of a blocked-sparse mesh (hashable: usable as a jit static).
+
+    ``k`` is the block width (pow2 so block shapes tile cleanly on TPU lanes
+    and costscope's N-sweeps stay comparable), ``gossip_fanout`` the number
+    of membership records piggybacked on each ack (the blocked twin of the
+    dense anti-entropy share), ``boot_contacts`` the ring contacts seeded at
+    init/revive (the gossip-boot analogue of the dense join broadcast, which
+    has no domain in a blocked world).
+    """
+
+    k: int = 16
+    gossip_fanout: int = 4
+    boot_contacts: int = 3
+    timer_dtype: str = "int32"
+
+    def __post_init__(self) -> None:
+        if self.k < 2 or (self.k & (self.k - 1)) != 0:
+            raise ValueError(f"k must be a power of two >= 2, got {self.k}")
+        if not 1 <= self.gossip_fanout <= self.k:
+            raise ValueError(
+                f"gossip_fanout must be in [1, k={self.k}], got {self.gossip_fanout}"
+            )
+        if not 1 <= self.boot_contacts <= self.k:
+            raise ValueError(
+                f"boot_contacts must be in [1, k={self.k}], got {self.boot_contacts}"
+            )
+        if self.timer_dtype not in _TIMER_DTYPES:
+            raise ValueError(
+                f"timer_dtype must be one of {_TIMER_DTYPES}, got {self.timer_dtype!r}"
+            )
+
+    @property
+    def timer_jnp_dtype(self):
+        return jnp.int16 if self.timer_dtype == "int16" else jnp.int32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SparseState:
+    """Pytree of the blocked-sparse planes (see module docstring)."""
+
+    nbr_idx: jax.Array  # int32 [N, K]
+    nbr_state: jax.Array  # int8 [N, K]
+    nbr_timer: jax.Array  # int32|int16 [N, K]
+    alive: jax.Array  # bool [N]
+    identity: jax.Array  # uint32 [N]
+    tick: jax.Array  # int32 scalar
+    seed: jax.Array  # uint32 scalar (counter-RNG base)
+    cursor: jax.Array  # uint32 scalar (counter-RNG cursor, +1 per tick)
+
+    @property
+    def n(self) -> int:
+        return self.nbr_idx.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.nbr_idx.shape[1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SparseTickInputs:
+    """Per-tick scenario inputs — the blocked twin of ``TickInputs``.
+
+    No ``partition``/``drop_ok`` matrices: edge faults are counter-draw
+    bernoullis against the scalar ``drop_rate``, never a materialized gate.
+    """
+
+    kill: jax.Array  # bool [N]
+    revive: jax.Array  # bool [N]
+    drop_rate: jax.Array  # float32 scalar
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SparseTickMetrics:
+    """Per-tick metrics, field-compatible with the dense ``TickMetrics``."""
+
+    messages_delivered: jax.Array  # int32
+    converged: jax.Array  # bool
+    agree_fraction: jax.Array  # float32
+    mean_membership: jax.Array  # float32
+    fingerprint_min: jax.Array  # uint32
+    fingerprint_max: jax.Array  # uint32
+    pings_sent: jax.Array  # int32
+    block_fill: jax.Array  # float32 — mean occupied fraction over alive rows
+
+
+def init_sparse_state(
+    n: int,
+    spec: SparseSpec,
+    seed: int = 0,
+    identities: jax.Array | None = None,
+    alive: jax.Array | None = None,
+    contacts: int | None = None,
+) -> SparseState:
+    """Fresh blocked mesh with ``contacts`` ring neighbors seeded per row.
+
+    ``contacts`` defaults to ``spec.boot_contacts``; pass ``n - 1`` (with
+    ``k >= n - 1``) for a full-view boot, the configuration the stat-pin
+    harness uses so the blocked fingerprint can reach exact agreement with
+    the dense oracle.
+    """
+    if n < 2:
+        raise ValueError(f"need at least 2 peers, got n={n}")
+    b = spec.boot_contacts if contacts is None else contacts
+    b = min(b, n - 1, spec.k)
+    if b < 1:
+        raise ValueError(f"contacts resolves to {b}; need at least 1")
+    tdt = spec.timer_jnp_dtype
+
+    rows = np.arange(n, dtype=np.int64)
+    slots = np.arange(spec.k, dtype=np.int64)
+    # Ring contacts i+1 .. i+b, the same seeding init_state uses for its
+    # dense `ring_contacts` — self-reference impossible since b <= n - 1.
+    idx = np.where(
+        slots[None, :] < b,
+        (rows[:, None] + 1 + slots[None, :]) % n,
+        -1,
+    ).astype(np.int32)
+    st = np.broadcast_to(
+        np.where(slots[None, :] < b, KNOWN, 0).astype(np.int8), (n, spec.k)
+    ).copy()
+
+    if identities is None:
+        identities = jnp.zeros((n,), jnp.uint32)
+    if alive is None:
+        alive = jnp.ones((n,), bool)
+    return SparseState(
+        nbr_idx=jnp.asarray(idx),
+        nbr_state=jnp.asarray(st),
+        nbr_timer=jnp.zeros((n, spec.k), tdt),
+        alive=alive,
+        identity=identities,
+        tick=jnp.zeros((), jnp.int32),
+        seed=jnp.uint32(seed),
+        cursor=jnp.zeros((), jnp.uint32),
+    )
+
+
+def sparse_idle_inputs(n: int, ticks: int | None = None) -> SparseTickInputs:
+    """No churn, no drops — leading ``ticks`` axis when scanning."""
+    shape = (n,) if ticks is None else (ticks, n)
+    zeros = jnp.zeros(shape, bool)
+    drop = jnp.zeros(() if ticks is None else (ticks,), jnp.float32)
+    return SparseTickInputs(kill=zeros, revive=zeros, drop_rate=drop)
+
+
+def sparse_fingerprint(st: SparseState) -> jax.Array:
+    """Per-row membership fingerprint, uint32 ``[N]``.
+
+    Commutative sum of ``peer_record_hash`` over the implicit self plus every
+    occupied slot — identical to ``membership_fingerprint`` of the equivalent
+    dense membership matrix, so dense and blocked views of the same world
+    hash equal and ``fingerprint_agreement`` applies unchanged.
+    """
+    n = st.n
+    rows = jnp.arange(n, dtype=jnp.int32)
+    occ = st.nbr_state > 0
+    safe = jnp.clip(st.nbr_idx, 0, n - 1)
+    self_h = peer_record_hash(rows, st.identity)
+    slot_h = peer_record_hash(safe, st.identity[safe])
+    return self_h + jnp.sum(
+        jnp.where(occ, slot_h, jnp.uint32(0)), axis=1, dtype=jnp.uint32
+    )

@@ -241,3 +241,87 @@ def test_atomic_save_is_complete_or_absent(tmp_path):
     checkpoint.save(path, st, atomic=True)
     _states_equal(st, checkpoint.load(path))
     assert list(tmp_path.iterdir()) == [path]
+
+
+# ---- sparse (blocked_topk) checkpoints -------------------------------------
+
+
+def _sparse_cfg_spec():
+    from kaboodle_tpu.sparseplane import SparseSpec
+
+    return SwimConfig(join_broadcast_enabled=False), SparseSpec(
+        k=16, gossip_fanout=4, boot_contacts=2
+    )
+
+
+def test_sparse_roundtrip_resume_bit_exact(tmp_path):
+    """Neighbor-index planes AND the counter-RNG (seed, cursor) round-trip:
+    a resumed sparse run replays the exact draw sequence an uninterrupted
+    one makes (draws are pure functions of the cursor)."""
+    from kaboodle_tpu.sparseplane import (
+        init_sparse_state, simulate_sparse, sparse_idle_inputs,
+    )
+
+    cfg, spec = _sparse_cfg_spec()
+    n = 24
+    st = init_sparse_state(n, spec, seed=11)
+    mid, _ = simulate_sparse(st, sparse_idle_inputs(n, 5), cfg, spec)
+    unbroken, _ = simulate_sparse(mid, sparse_idle_inputs(n, 6), cfg, spec)
+
+    path = tmp_path / "sparse.npz"
+    checkpoint.save_sparse(path, mid, atomic=True)
+    resumed_mid = checkpoint.load_sparse(path)
+    _states_equal(mid, resumed_mid)
+    resumed, _ = simulate_sparse(
+        resumed_mid, sparse_idle_inputs(n, 6), cfg, spec
+    )
+    _states_equal(unbroken, resumed)
+    assert list(tmp_path.iterdir()) == [path]  # atomic: no temp survives
+
+
+def test_sparse_checkpoint_guards(tmp_path):
+    """Schema marker + torn/alien files: the three checkpoint families can
+    never cross-restore, and a torn sparse archive surfaces as
+    CheckpointError, not a raw zipfile exception."""
+    import numpy as np
+
+    from kaboodle_tpu.errors import CheckpointError
+    from kaboodle_tpu.sparseplane import init_sparse_state
+
+    cfg, spec = _sparse_cfg_spec()
+    st = init_sparse_state(16, spec, seed=3)
+    sp = tmp_path / "sparse.npz"
+    checkpoint.save_sparse(sp, st)
+
+    # a sparse archive is not a dense or fleet checkpoint...
+    with pytest.raises(CheckpointError):
+        checkpoint.load(sp)
+    with pytest.raises(CheckpointError):
+        checkpoint.load_fleet(sp)
+    # ...and a dense archive is not a sparse one
+    dense = tmp_path / "dense.npz"
+    checkpoint.save(dense, init_state(8, seed=1))
+    with pytest.raises(CheckpointError, match="not a sparse checkpoint"):
+        checkpoint.load_sparse(dense)
+
+    torn = tmp_path / "torn.npz"
+    torn.write_bytes(sp.read_bytes()[: sp.stat().st_size // 3])
+    with pytest.raises(CheckpointError):
+        checkpoint.load_sparse(torn)
+    alien = tmp_path / "alien.npz"
+    alien.write_bytes(b"definitely not a zip archive\n" * 4)
+    with pytest.raises(CheckpointError):
+        checkpoint.load_sparse(alien)
+    with pytest.raises(CheckpointError):
+        checkpoint.load_sparse(tmp_path / "missing.npz")
+
+    # a sparse archive with a plane deleted names the missing field
+    partial = {
+        k: np.asarray(v)
+        for k, v in np.load(sp).items()
+        if k != "sparse.cursor"
+    }
+    short = tmp_path / "short.npz"
+    np.savez(short, **partial)
+    with pytest.raises(CheckpointError, match="cursor"):
+        checkpoint.load_sparse(short)

@@ -754,3 +754,142 @@ def test_random_spill_kill_recover_arm(seed, tmp_path):
         f"seed {seed}: {box.count} fresh compilations across the "
         f"kill/recover boundary"
     )
+
+
+# ---- sparseplane (ISSUE 18): distribution-stat fuzz ------------------------
+# The blocked_topk engine is NOT bit-pinned to the dense oracle — counter
+# draws replace the [N, N] key grid, so trajectories differ by design. The
+# contract is statistical: over matched seeds and randomized scenarios the
+# sparse twin must land in calibrated bands around the dense oracle's
+# behavior (convergence-tick ratio, steady-tick counter means, fingerprint
+# agreement at convergence), and its steady tick must compile nothing
+# after warmup.
+
+
+def _sparse_ctx(rng):
+    from kaboodle_tpu.sparseplane import SparseSpec
+
+    n = int(rng.integers(16, 28))
+    boot = int(rng.integers(1, 4))
+    cfg = SwimConfig(join_broadcast_enabled=False)
+    # k >= n-1: full-view blocks, so "converged" means the same predicate
+    # the dense runner tests (fingerprint agreement over the full view)
+    spec = SparseSpec(k=32, gossip_fanout=4, boot_contacts=boot)
+    return n, boot, cfg, spec
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_boot_sparse_vs_dense_convergence_band(seed):
+    """Matched-seed boots: dense and sparse both reach full agreement, and
+    the sparse convergence tick sits inside the calibrated band around the
+    dense one (empirically ~2.1x slower at gossip_fanout=4 vs the dense
+    uncapped share; the band is generous because the engines draw from
+    different RNG chains by design)."""
+    from kaboodle_tpu.sim.runner import run_until_converged
+    from kaboodle_tpu.sparseplane import (
+        init_sparse_state,
+        run_sparse_until_converged,
+        sparse_fingerprint,
+    )
+
+    rng = np.random.default_rng(9000 + seed)
+    n, boot, cfg, spec = _sparse_ctx(rng)
+
+    dst = init_state(n, seed=seed, ring_contacts=boot)
+    _, dticks, dconv = run_until_converged(dst, cfg, max_ticks=96)
+    assert bool(dconv), f"dense arm failed to converge (seed {seed})"
+
+    sst = init_sparse_state(n, spec, seed=seed)
+    fin, sticks, sconv = run_sparse_until_converged(
+        sst, cfg, spec, max_ticks=96
+    )
+    assert bool(sconv), f"sparse arm failed to converge (seed {seed})"
+    d, s = int(dticks), int(sticks)
+    assert d // 2 <= s <= 4 * d + 10, (
+        f"sparse convergence {s} ticks outside the band around dense {d} "
+        f"(seed {seed}, n={n}, boot={boot})"
+    )
+    # agreement at convergence is total, same as the dense predicate
+    fp = np.asarray(sparse_fingerprint(fin))
+    assert (fp == fp[0]).all()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sparse_steady_tick_counter_means(seed):
+    """The steady-state counter pin: a converged sparse mesh with zero
+    drops emits EXACTLY n pings and 2n delivered messages per tick (every
+    alive peer draws one target; every ping acks; no expiry chains, no
+    gossip inserts move membership), at agreement 1.0 and full mean
+    membership — the per-tick counter means the dense steady tick shows."""
+    from kaboodle_tpu.sparseplane import (
+        init_sparse_state,
+        run_sparse_until_converged,
+        simulate_sparse,
+        sparse_idle_inputs,
+    )
+
+    rng = np.random.default_rng(9100 + seed)
+    n, _, cfg, spec = _sparse_ctx(rng)
+    st, _, conv = run_sparse_until_converged(
+        init_sparse_state(n, spec, seed=seed), cfg, spec, max_ticks=96
+    )
+    assert bool(conv)
+    _, m = simulate_sparse(st, sparse_idle_inputs(n, ticks=16), cfg, spec)
+    assert (np.asarray(m.pings_sent) == n).all()
+    assert (np.asarray(m.messages_delivered) == 2 * n).all()
+    assert (np.asarray(m.agree_fraction) == 1.0).all()
+    assert (np.asarray(m.mean_membership) == float(n)).all()
+    assert np.asarray(m.converged).all()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_sparse_recompile_counter_zero_after_warmup(seed):
+    """The KB405 property on the sparse engine: a warmed 64-tick sparse
+    run — randomized churn schedule, nonzero drop rate — triggers ZERO
+    fresh compiles on re-dispatch from a different initial state (same
+    shapes). The million-peer bench's compiles_steady=0 gate, at toy N."""
+    from kaboodle_tpu.analysis.ir.surface import (
+        assert_counter_live,
+        compile_counter,
+    )
+    from kaboodle_tpu.sparseplane import (
+        init_sparse_state,
+        run_sparse_until_converged,
+        simulate_sparse,
+        sparse_idle_inputs,
+    )
+
+    assert_counter_live()
+    rng = np.random.default_rng(9200 + seed)
+    n, _, cfg, spec = _sparse_ctx(rng)
+    ticks = 64
+    idle = sparse_idle_inputs(n, ticks=ticks)
+    kill = np.zeros((ticks, n), bool)
+    revive = np.zeros((ticks, n), bool)
+    for t in sorted(rng.choice(ticks, size=3, replace=False)):
+        if rng.integers(2):
+            kill[t, rng.integers(n)] = True
+        else:
+            revive[t, rng.integers(n)] = True
+    import dataclasses as dc
+
+    inputs = dc.replace(
+        idle,
+        kill=jnp.asarray(kill),
+        revive=jnp.asarray(revive),
+        drop_rate=jnp.full((ticks,), 0.05, jnp.float32),
+    )
+
+    # warm-up: the scanned tick and the converge runner, once each
+    st = init_sparse_state(n, spec, seed=seed)
+    simulate_sparse(st, inputs, cfg, spec)
+    run_sparse_until_converged(st, cfg, spec, max_ticks=32)
+
+    st_b = init_sparse_state(n, spec, seed=seed + 23)
+    with compile_counter() as box:
+        simulate_sparse(st_b, inputs, cfg, spec)
+        run_sparse_until_converged(st_b, cfg, spec, max_ticks=32)
+    assert box.count == 0, (
+        f"{box.count} fresh compiles in a warmed 64-tick sparse run "
+        f"(seed {seed}) — the sparse engine started minting programs"
+    )

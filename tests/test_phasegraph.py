@@ -171,7 +171,7 @@ def test_plan_rejects_unknown_mode():
     g = build_graph(_cfg(), faulty=True)
     with pytest.raises(ValueError, match="unknown plan mode"):
         plan(g, "turbo")
-    assert set(MODES) == {"full", "fused", "span", "blocked", "hybrid"}
+    assert set(MODES) == {"full", "fused", "span", "blocked", "hybrid", "sparse"}
 
 
 def test_describe_is_jsonable_and_names_passes():

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun sparse-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun rng-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun sparse-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -12,26 +12,23 @@ test:
 test-quick:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-# graftlint + graftscan + graftconc: the three-lane static gate. Line 1
-# is the dependency-free JAX/TPU-aware AST lane — the clippy `-D warnings`
-# analogue (reference main.yml:48-52), rules KB1xx/KB2xx/KB3xx, parse
-# speed. Line 2 is the IR lane (kaboodle_tpu/analysis/ir/): rules
-# KB401-KB405 over the TRACED kernel entry points — dtype widening under
-# x64, host callbacks, baked-in constants, GSPMD spec derivation, and the
-# compile-surface budget (.graftscan_surface.json) measured by a scripted
-# dense+warp+fleet exercise (~1 min on CPU, the only compile-heavy step).
-# Line 3 is the concurrency lane (kaboodle_tpu/analysis/conc/): rules
-# KB501-KB506 over the serve plane's three execution contexts — event-loop
-# blocking, guarded_by lock discipline, device values crossing threads,
-# durable-write protocol, lock-order cycles, unbounded queues — same AST
-# machinery as line 1, its own debt file (.graftconc_baseline.json).
-# `--no-baseline-growth` makes ALL checked-in baselines monotonically
-# shrinking debt. See kaboodle_tpu/analysis/ (scripts/lint.py is a shim).
+# The four-lane static gate in ONE invocation (`--all`): graftlint
+# (KB1xx-3xx, dependency-free AST — the clippy `-D warnings` analogue,
+# reference main.yml:48-52), graftconc (KB5xx, the serve-plane
+# concurrency auditor, .graftconc_baseline.json), graftscan (KB4xx over
+# the TRACED kernel entry-point registry + the compile-surface budget in
+# .graftscan_surface.json — the compile-heavy step, ~1 min on CPU), and
+# keyscope (KB6xx key provenance over the same traced registry, with the
+# committed-leap-report freshness gate, .keyscope_baseline.json). Lanes
+# run cheap-to-expensive against their own debt files; one combined rc
+# with a per-lane summary line. `--no-baseline-growth` makes ALL
+# checked-in baselines monotonically shrinking debt. The env wrapper is
+# for the two traced lanes (CPU pin + wedge timeout); the AST lanes
+# don't import jax either way. See kaboodle_tpu/analysis/
+# (scripts/lint.py is a shim).
 lint:
-	$(PYTHON) -m kaboodle_tpu.analysis --no-baseline-growth
-	timeout 300 env JAX_PLATFORMS=cpu \
-	  $(PYTHON) -m kaboodle_tpu.analysis --ir --no-baseline-growth
-	$(PYTHON) -m kaboodle_tpu.analysis --conc --no-baseline-growth
+	timeout 600 env JAX_PLATFORMS=cpu \
+	  $(PYTHON) -m kaboodle_tpu.analysis --all --no-baseline-growth
 	$(PYTHON) scripts/license_check.py
 
 native:
@@ -63,6 +60,7 @@ sim:
 # stats -> table/JSON output) end-to-end at toy scale.
 ci: lint native test
 	$(MAKE) conc-dryrun
+	$(MAKE) rng-dryrun
 	timeout 420 $(PYTHON) __graft_entry__.py
 	timeout 300 $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 	$(MAKE) fleet-dryrun
@@ -231,6 +229,18 @@ scan-dryrun:
 # serve-chaos-dryrun and the serve/obsplane test suites.
 conc-dryrun:
 	$(PYTHON) -m kaboodle_tpu.analysis --conc --no-baseline-growth
+
+# keyscope standalone (ISSUE 19): the key-provenance gate — trace the
+# entry-point registry, build per-entry provenance graphs, run KB601-604
+# against .keyscope_baseline.json (shrink-only debt), and fail if the
+# committed KEYSCOPE_LEAP.json no longer matches what the code traces to
+# (--check-leap: the KB605 classification is a banked artifact, its
+# regeneration is deterministic, so CI diffs it instead of trusting it).
+# Regenerate after an intentional RNG change with
+# `python -m kaboodle_tpu.analysis --rng --write-leap` and commit.
+rng-dryrun:
+	timeout 300 env JAX_PLATFORMS=cpu \
+	  $(PYTHON) -m kaboodle_tpu.analysis --rng --check-leap --no-baseline-growth
 
 # Sharded scale proof (behavioral): epidemic-boot to asserted convergence,
 # then the every-fault-path scan, N=8192 over 8 virtual CPU devices,

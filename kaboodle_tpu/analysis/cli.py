@@ -1,40 +1,51 @@
-"""graftlint/graftscan CLI: ``python -m kaboodle_tpu.analysis [options]``.
+"""graftlint/graftscan/graftconc/keyscope CLI: ``python -m kaboodle_tpu.analysis``.
 
 Exit codes: 0 clean (baselined findings allowed), 1 findings / baseline
 violations, 2 usage or baseline-format error.
 
-Three lanes share one UX:
+Four lanes share one UX:
 
 - **AST lane** (default): rules KB1xx-KB3xx over the source tree. Pure
   ``ast`` + stdlib — no jax, parse speed.
 - **IR lane** (``--ir``): rules KB401-KB405 over the *traced* kernel entry
   points (kaboodle_tpu/analysis/ir/) plus the compile-surface budget.
-  Imports jax (CPU-pinned), so it is its own invocation — ``make lint``
-  runs both lines.
+  Imports jax (CPU-pinned), so it is its own invocation.
 - **conc lane** (``--conc``, or the ``conc`` subcommand): rules
   KB501-KB506 (kaboodle_tpu/analysis/conc/) — the host-concurrency
   auditor for the serve plane. Same dependency-free AST machinery as the
   default lane but a separate gate: its scope, findings, and debt file
   (``.graftconc_baseline.json``) evolve independently of graftlint's.
-  ``make conc-dryrun`` / ``make lint`` line 3 run it.
+- **rng lane** (``--rng``, or the ``rng`` subcommand): rules KB601-KB605
+  (kaboodle_tpu/analysis/rng/) — keyscope, the key-provenance auditor
+  over the same traced registry the IR lane reads. Key reuse, stream-id
+  collisions, resume impurity, cross-engine chain divergence, and the
+  banked leapability report (``--leap-report`` / ``--write-leap`` /
+  ``--check-leap`` against ``KEYSCOPE_LEAP.json``). Debt file:
+  ``.keyscope_baseline.json``. ``make rng-dryrun`` runs the CI shape.
+
+``--all`` runs every lane in one invocation (AST, conc, IR, rng — each
+against its own baseline) and reports one combined exit code with a
+per-lane summary line: ``make lint`` is one process instead of four.
 
 Modes (all lanes):
 
 - default: report every finding whose key is not in the lane's baseline
   (``.graftlint_baseline.json`` / ``.graftscan_baseline.json`` /
-  ``.graftconc_baseline.json``).
+  ``.graftconc_baseline.json`` / ``.keyscope_baseline.json``).
 - ``--no-baseline-growth``: additionally fail on *stale* baseline entries
   (keys that no longer match any finding) and, in the IR lane, on a
   compile-surface count below its committed budget. Together with the
-  default mode this makes both baselines monotonically shrinking.
+  default mode this makes every baseline monotonically shrinking.
 - ``--write-baseline``: regenerate the lane's baseline, preserving
   reasons; ``--write-surface`` (IR) regenerates the surface budget.
-- ``--explain KBnnn`` / ``--list-rules``: rule documentation (all
-  families, either lane — the registry is shared).
+- ``--explain KBnnn`` / ``--list-rules``: rule documentation (all six
+  families, any lane — the registry is shared).
 
-IR-lane extras: ``--entries a,b`` scans only the named entry points;
-``--no-surface`` skips the (compile-heavy) KB405 exercise — for fast local
-iteration only, the gate always runs it.
+IR/rng-lane extras: ``--entries a,b`` scans only the named entry points;
+``--no-surface`` (IR) skips the compile-heavy KB405 exercise — for fast
+local iteration only, the gate always runs it. The rng leap-report
+freshness gate (``--check-leap``) only runs on full-registry scans: a
+scoped ``--entries`` run can never prove the committed report current.
 """
 
 from __future__ import annotations
@@ -50,6 +61,7 @@ DEFAULT_TARGETS = [
 
 DEFAULT_IR_BASELINE = ".graftscan_baseline.json"
 DEFAULT_CONC_BASELINE = ".graftconc_baseline.json"
+DEFAULT_RNG_BASELINE = ".keyscope_baseline.json"
 
 USAGE = """\
 usage: python -m kaboodle_tpu.analysis [options] [paths...]
@@ -57,7 +69,8 @@ usage: python -m kaboodle_tpu.analysis [options] [paths...]
 options:
   --baseline PATH        baseline file (default: .graftlint_baseline.json;
                          .graftscan_baseline.json with --ir;
-                         .graftconc_baseline.json with --conc)
+                         .graftconc_baseline.json with --conc;
+                         .keyscope_baseline.json with --rng)
   --no-baseline          ignore the baseline entirely
   --no-baseline-growth   also fail on stale baseline entries (CI debt gate)
   --write-baseline       regenerate the baseline from current findings
@@ -67,30 +80,45 @@ options:
                          AST lane; traces the kernel entry-point registry
   --conc                 run the concurrency lane (graftconc, KB5xx) over
                          the serve scope ('conc' as first arg works too)
-  --entries a,b          (--ir) scan only the named entry points
+  --rng                  run the key-provenance lane (keyscope, KB6xx) over
+                         the traced registry ('rng' as first arg works too)
+  --all                  run every lane (AST, conc, IR, rng) against their
+                         own baselines; one combined exit code
+  --entries a,b          (--ir/--rng) scan only the named entry points
   --surface PATH         (--ir) surface budget (default: .graftscan_surface.json)
   --write-surface        (--ir) regenerate the surface budget file
   --no-surface           (--ir) skip the compile-surface exercise (KB405)
+  --leap PATH            (--rng) leap report file (default: KEYSCOPE_LEAP.json)
+  --leap-report          (--rng) print the leapability report (KB605 table)
+  --write-leap           (--rng) regenerate + write the leap report file
+  --check-leap           (--rng) fail if the committed leap report is stale
   -h, --help             this message
 """
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    # `python -m kaboodle_tpu.analysis conc ...` == `... --conc ...`: the
-    # subcommand spelling matches the other kaboodle_tpu CLI planes.
-    if argv and argv[0] == "conc":
-        argv[0] = "--conc"
+    # `python -m kaboodle_tpu.analysis conc ...` == `... --conc ...` (and
+    # `rng` likewise): the subcommand spelling matches the other
+    # kaboodle_tpu CLI planes.
+    if argv and argv[0] in ("conc", "rng"):
+        argv[0] = "--" + argv[0]
     baseline_path: pathlib.Path | None = None
     use_baseline = True
     no_growth = False
     write = False
     ir_mode = False
     conc_mode = False
+    rng_mode = False
+    all_mode = False
     entries_filter: list[str] | None = None
     surface_path: pathlib.Path | None = None
     write_surface = False
     with_surface = True
+    leap_path: pathlib.Path | None = None
+    leap_report = False
+    write_leap = False
+    check_leap = False
     targets: list[str] = []
 
     core._load_rules()
@@ -116,6 +144,10 @@ def main(argv: list[str] | None = None) -> int:
             ir_mode = True
         elif a == "--conc":
             conc_mode = True
+        elif a == "--rng":
+            rng_mode = True
+        elif a == "--all":
+            all_mode = True
         elif a == "--entries":
             i += 1
             if i >= len(argv):
@@ -132,6 +164,18 @@ def main(argv: list[str] | None = None) -> int:
             write_surface = True
         elif a == "--no-surface":
             with_surface = False
+        elif a == "--leap":
+            i += 1
+            if i >= len(argv):
+                print("--leap needs a path", file=sys.stderr)
+                return 2
+            leap_path = pathlib.Path(argv[i])
+        elif a == "--leap-report":
+            leap_report = True
+        elif a == "--write-leap":
+            write_leap = True
+        elif a == "--check-leap":
+            check_leap = True
         elif a == "--list-rules":
             for rid in sorted(core.REGISTRY):
                 print(f"{rid}  {core.REGISTRY[rid].title}")
@@ -152,10 +196,20 @@ def main(argv: list[str] | None = None) -> int:
             targets.append(a)
         i += 1
 
-    if ir_mode and conc_mode:
-        print("--ir and --conc are separate lanes; run them separately",
+    lanes_picked = sum((ir_mode, conc_mode, rng_mode))
+    if lanes_picked > 1 or (all_mode and lanes_picked):
+        print("--ir/--conc/--rng/--all are exclusive; pick one",
               file=sys.stderr)
         return 2
+    if all_mode:
+        if write or write_surface or write_leap or targets or entries_filter:
+            print(
+                "--all is the read-only gate over every lane; regenerate "
+                "baselines per-lane (--ir --write-baseline, ...)",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_all(use_baseline, no_growth, with_surface)
     if ir_mode:
         if targets:
             print(
@@ -174,10 +228,43 @@ def main(argv: list[str] | None = None) -> int:
             write_surface,
             with_surface,
         )
+    if rng_mode:
+        if targets:
+            print(
+                "--rng scans the entry-point registry, not paths; use "
+                "--entries name,... to scope it",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_rng(
+            baseline_path or pathlib.Path(DEFAULT_RNG_BASELINE),
+            use_baseline,
+            no_growth,
+            write,
+            entries_filter,
+            leap_path,
+            leap_report,
+            write_leap,
+            check_leap,
+        )
+    return _run_ast(
+        conc_mode, baseline_path, use_baseline, no_growth, write, targets
+    )
 
-    # Lane split: the default AST lane runs KB1xx-KB3xx; --conc runs only
-    # the KB5xx rules (scope-gated to the serve plane) against its own
-    # baseline. One registry, two debt files.
+
+def _run_ast(
+    conc_mode: bool,
+    baseline_path: pathlib.Path | None,
+    use_baseline: bool,
+    no_growth: bool,
+    write: bool,
+    targets: list[str],
+) -> int:
+    """The source lanes: graftlint (KB1xx-3xx) or graftconc (KB5xx).
+
+    One registry, two debt files — KB5xx rules are scope-gated to the
+    serve plane and run only under --conc; KB4xx/KB6xx registrations are
+    documentation-only no-ops either way."""
     lane = "graftconc" if conc_mode else "graftlint"
     rules = [
         core.REGISTRY[rid]
@@ -322,4 +409,162 @@ def _run_ir(
         f"findings" + (f" ({suppressed} baselined)" if suppressed else "") + surf,
         file=sys.stderr,
     )
+    return rc
+
+
+def _run_rng(
+    baseline_path: pathlib.Path,
+    use_baseline: bool,
+    no_growth: bool,
+    write_baseline: bool,
+    entries_filter: list[str] | None,
+    leap_path: pathlib.Path | None,
+    leap_report: bool,
+    write_leap: bool,
+    check_leap: bool,
+) -> int:
+    """The --rng lane: keyscope over the traced registry.
+
+    Same baseline semantics as the other lanes; the leap report rides the
+    same scan (graphs are already built) so ``--check-leap`` costs no
+    extra traces. KB605 freshness findings are NOT baselineable — the
+    leap report file is the only accepted record of the classification,
+    and a 'stale' justification would disable the gate forever."""
+    from kaboodle_tpu.analysis.rng import scan as rng_scan
+
+    if leap_path is None:
+        leap_path = pathlib.Path(rng_scan.DEFAULT_LEAP_REPORT)
+    costscope_path = _default_costscope_path()
+
+    try:
+        baseline = core.load_baseline(baseline_path) if use_baseline else {}
+    except core.BaselineError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    try:
+        result = rng_scan.run_rng_scan(
+            entry_names=entries_filter,
+            progress=lambda msg: print(msg, file=sys.stderr),
+        )
+    except KeyError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if write_baseline:
+        core.write_baseline(baseline_path, result.findings, baseline)
+        print(
+            f"keyscope: wrote {baseline_path} with "
+            f"{len({x.key for x in result.findings})} entries",
+            file=sys.stderr,
+        )
+    if write_leap:
+        if entries_filter:
+            print(
+                "--write-leap needs the full registry (drop --entries): a "
+                "partial report would mark every unscanned entry stale",
+                file=sys.stderr,
+            )
+            return 2
+        report = rng_scan.build_leap_report(
+            result.graphs, costscope_path=costscope_path
+        )
+        rng_scan.write_leap_report(report, leap_path)
+        print(f"keyscope: wrote {leap_path}", file=sys.stderr)
+    if write_baseline or write_leap:
+        return 0
+
+    findings = list(result.findings)
+    if check_leap:
+        if entries_filter:
+            print(
+                "keyscope: --check-leap skipped (scoped --entries run "
+                "cannot validate the full-registry report)",
+                file=sys.stderr,
+            )
+        else:
+            findings.extend(
+                rng_scan.leap_findings(
+                    result.graphs, leap_path, costscope_path=costscope_path
+                )
+            )
+
+    active = [
+        f for f in findings if f.rule == "KB605" or f.key not in baseline
+    ]
+    suppressed = len(findings) - len(active)
+    for f in active:
+        print(f.render())
+
+    rc = 1 if active else 0
+    if no_growth:
+        live_keys = {f.key for f in findings if f.rule != "KB605"}
+        stale = sorted(k for k in baseline if k not in live_keys)
+        for k in stale:
+            print(f"stale baseline entry (fixed? delete it): {k}")
+        if stale:
+            rc = 1
+
+    if leap_report:
+        report = rng_scan.build_leap_report(
+            result.graphs, costscope_path=costscope_path
+        )
+        print(rng_scan.render_leap_report(report))
+
+    sinks = sum(len(g.sinks) for g in result.graphs.values())
+    print(
+        f"keyscope: {result.entries_scanned} entry points, {sinks} draw "
+        f"sinks, {len(active)} findings"
+        + (f" ({suppressed} baselined)" if suppressed else ""),
+        file=sys.stderr,
+    )
+    return rc
+
+
+def _default_costscope_path() -> pathlib.Path | None:
+    """The committed costscope baseline, if present (leap-report byte join)."""
+    try:
+        from kaboodle_tpu.costscope.baseline import DEFAULT_BASELINE
+
+        p = pathlib.Path(DEFAULT_BASELINE)
+        return p if p.exists() else None
+    except Exception:
+        return None
+
+
+def _run_all(use_baseline: bool, no_growth: bool, with_surface: bool) -> int:
+    """``--all``: every lane, own baselines, one combined exit code.
+
+    Lane order is cheap-to-expensive (AST, conc, then the two traced
+    lanes) so source-level failures surface in the first seconds. Every
+    lane always runs — one combined report, not fail-fast — because the
+    lanes gate independent debt files."""
+    results: list[tuple[str, int]] = []
+    results.append(
+        ("graftlint", _run_ast(False, None, use_baseline, no_growth, False, []))
+    )
+    results.append(
+        ("graftconc", _run_ast(True, None, use_baseline, no_growth, False, []))
+    )
+    results.append(
+        (
+            "graftscan",
+            _run_ir(
+                pathlib.Path(DEFAULT_IR_BASELINE),
+                use_baseline, no_growth, False, None, None, False, with_surface,
+            ),
+        )
+    )
+    results.append(
+        (
+            "keyscope",
+            _run_rng(
+                pathlib.Path(DEFAULT_RNG_BASELINE),
+                use_baseline, no_growth, False, None, None, False, False, True,
+            ),
+        )
+    )
+    rc = max(code for _, code in results)
+    summary = " ".join(f"{lane}={code}" for lane, code in results)
+    print(f"analysis --all: {summary} -> rc {rc}", file=sys.stderr)
     return rc

@@ -225,12 +225,15 @@ def _load_rules() -> None:
     rules_ir registers only the KB4xx documentation (no-op AST checks);
     the passes themselves live in analysis/ir/ behind the --ir lane.
     conc.rules (KB5xx) register here too — --list-rules/--explain cover
-    every family — but the CLI runs them only in the --conc lane."""
+    every family — but the CLI runs them only in the --conc lane.
+    rules_rng (KB6xx) is the same shape as rules_ir: documentation only,
+    the provenance checks live in analysis/rng/ behind --rng."""
     from kaboodle_tpu.analysis import (  # noqa: F401
         rules_generic,
         rules_hotpath,
         rules_ir,
         rules_jax,
+        rules_rng,
     )
     from kaboodle_tpu.analysis.conc import rules as rules_conc  # noqa: F401
 

@@ -1,0 +1,52 @@
+"""keyscope — the PRNG key-provenance auditor (KB6xx, the rng lane).
+
+The repo runs two RNG disciplines side by side: the dense engines carry a
+threefry key through the state and ``split(key, 5)`` it every tick
+(phasegraph/ops.py ``KEY_LAYOUT``), while sparseplane derives every draw
+on the fly from checkpointable ``(seed, cursor)`` counters folded per
+``STREAM_*`` id (sparseplane/rng.py). Nothing but convention keeps the two
+collision-free, resume-pure, and bit-exact across the five engines derived
+from one op graph — so keyscope walks each traced graftscan registry
+entry's jaxpr, rebuilds the **key-provenance graph** (roots: carried state
+key / counter seed; edges: split-row, fold_in; sinks: the shaped
+``random_bits`` draws) and checks it mechanically:
+
+- **KB601** key reuse — two draw sinks on the same unforked key,
+- **KB602** stream-id collision / registry drift,
+- **KB603** resume-impurity — a draw not rooted in checkpointable state,
+- **KB604** cross-engine chain divergence against the declared fates,
+- **KB605** leapability — every sink classed ``counter_keyed`` (leapable)
+  or ``chain_coupled`` (dense-blocking), banked as the leap report that
+  names ROADMAP item 2's migration worklist.
+
+Run via ``python -m kaboodle_tpu.analysis --rng`` (the CLI's fourth lane,
+``.keyscope_baseline.json`` debt file) or ``make rng-dryrun``.
+"""
+
+from kaboodle_tpu.analysis.rng.provenance import (
+    ProvenanceGraph,
+    Sink,
+    build_provenance,
+)
+from kaboodle_tpu.analysis.rng.rules import (
+    CHAIN_GROUPS,
+    KEYSCOPE_STREAMS,
+)
+from kaboodle_tpu.analysis.rng.scan import (
+    DEFAULT_LEAP_REPORT,
+    build_leap_report,
+    leap_findings,
+    run_rng_scan,
+)
+
+__all__ = [
+    "ProvenanceGraph",
+    "Sink",
+    "build_provenance",
+    "CHAIN_GROUPS",
+    "KEYSCOPE_STREAMS",
+    "DEFAULT_LEAP_REPORT",
+    "build_leap_report",
+    "leap_findings",
+    "run_rng_scan",
+]

@@ -1,0 +1,657 @@
+"""Key-provenance graphs over traced jaxprs (the keyscope engine).
+
+Under jax 0.4.37 the threefry lifecycle is fully visible in the jaxpr:
+raw ``uint32[2]`` keys are ``random_wrap``-ed into typed key arrays at
+every ``jax.random`` call, forked via ``random_split`` /
+``random_fold_in``, and consumed by ``random_bits`` — the one primitive
+every ``uniform``/``bernoulli``/``randint`` bottoms out in. The split-row
+idiom the dense engines compile to is::
+
+    random_split -> random_unwrap (u32[m,2]) -> slice[(r,0):(r+1,2)]
+                 -> squeeze -> random_wrap          # "row r of the split"
+
+:func:`build_provenance` walks one traced entry's ClosedJaxpr and
+rebuilds the key dataflow as a DAG of :class:`Node`:
+
+- **roots** — where key material enters: ``carried_key`` (a
+  ``random_wrap`` of argument-derived ``u32[2]``, i.e. the checkpointed
+  state key), ``counter_seed`` (``random_seed`` on an argument-derived
+  scalar, i.e. sparseplane's ``(seed, cursor)`` discipline), and their
+  resume-impure twins ``const_key`` / ``const_seed`` (key material baked
+  into the program — KB603's target);
+- **edges** — ``split`` / ``row`` / ``fold`` (fold constants are the
+  ``STREAM_*`` ids when the chain roots in a counter seed — KB602's
+  target), plus ``carry`` (a fresh per-iteration key entering a
+  scan/while body), ``stack`` (scan-stacked per-iteration keys) and
+  ``merge`` (cond branches disagreeing on a key output);
+- **sinks** — every shaped ``random_bits`` draw, annotated with its
+  branch path (so two draws on the same key in *mutually exclusive*
+  ``cond`` branches are not reuse — the dispatched dense build puts its
+  full and fused programs under one ``lax.cond``) and a ``looped`` flag
+  (a loop-invariant key drawn inside a scan body is reuse even with a
+  single textual sink).
+
+Two dataflow tracks run side by side: a per-var **taint** (which
+top-level arguments feed a value — empty taint = constant material) for
+every value, and the key-node env for key-typed values and their raw
+``u32`` shadows. Canonicalisation is structural: re-extracting row ``r``
+of the same split, or re-folding the same constant onto the same parent,
+lands on the same :class:`Node`, because ``jax.make_jaxpr`` does not CSE
+and textual duplicates would otherwise never collide. Rules (KB601-605)
+live in :mod:`kaboodle_tpu.analysis.rng.rules`; this module only builds
+the graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+from kaboodle_tpu.analysis.ir.walk import Source, aval_nbytes, source_of
+
+ROOT_KINDS = frozenset({"carried_key", "counter_seed", "const_key", "const_seed"})
+
+# Ops that move raw key bytes without transforming them: the unwrapped
+# u32 shadow of a key survives these.
+_RAW_TRANSPARENT = frozenset({"squeeze", "reshape", "copy", "expand_dims", "transpose"})
+
+# Call-like primitives walked transparently (positional invar mapping),
+# name -> params key holding the (Closed)Jaxpr.
+_CALL_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "remat2": "jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "sharding_constraint": None,  # identity on its operand
+    "custom_partitioning": "call_jaxpr",
+}
+
+
+def _literal_value(v) -> Any:
+    """Python scalar of a jaxpr Literal, else None (not a literal)."""
+    val = getattr(v, "val", None)
+    if val is None or hasattr(v, "count"):  # Vars have .count, Literals don't
+        return None
+    try:
+        return val.item() if hasattr(val, "item") and getattr(val, "ndim", 1) == 0 else val
+    except Exception:
+        return val
+
+
+def _is_key_aval(aval) -> bool:
+    return str(getattr(aval, "dtype", "")).startswith("key<")
+
+
+@dataclasses.dataclass(eq=False)
+class Node:
+    """One key value in the provenance DAG (identity-hashed).
+
+    ``descr()`` is the canonical, engine-portable name of the derivation
+    chain — ``carried_key/split5[1]`` is "row ping of the tick split"
+    regardless of which engine or nesting depth produced it. KB602
+    groups folds and KB604 compares engines on descriptors, never on
+    node identity.
+    """
+
+    kind: str  # ROOT_KINDS | "split" | "row" | "fold" | "carry" | "stack" | "merge"
+    parents: tuple = ()
+    taint: frozenset = frozenset()
+    looped: bool = False
+    m: int | None = None  # split width
+    row: int | None = None  # row index within the parent split
+    const: Any = None  # fold constant (None = traced fold operand)
+    src: Source | None = None
+    _descr: str | None = dataclasses.field(default=None, repr=False)
+
+    def descr(self) -> str:
+        if self._descr is None:
+            self._descr = self._render()
+        return self._descr
+
+    def _render(self) -> str:
+        if self.kind in ROOT_KINDS:
+            return self.kind
+        p = self.parents[0].descr() if self.parents else "?"
+        if self.kind == "split":
+            return f"{p}/split{self.m}"
+        if self.kind == "row":
+            return f"{p}[{self.row}]"
+        if self.kind == "fold":
+            c = "?" if self.const is None else self.const
+            return f"{p}/fold[{c}]"
+        if self.kind == "carry":
+            return f"{p}/carry"
+        if self.kind == "stack":
+            return f"{p}/stack"
+        if self.kind == "merge":
+            inner = ",".join(sorted({q.descr() for q in self.parents}))
+            return f"merge({inner})"
+        return f"{p}/{self.kind}"
+
+    def roots(self) -> frozenset:
+        """Root *kinds* reachable upward from this node."""
+        out, stack, seen = set(), [self], set()
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if n.kind in ROOT_KINDS:
+                out.add(n.kind)
+            stack.extend(n.parents)
+        return frozenset(out)
+
+    def layout_row(self) -> int | None:
+        """Row index of the nearest split-row ancestor (self included).
+
+        This is the KEY_LAYOUT coordinate of a dense-chain key: the leap
+        report names sinks by it (``phasegraph.ops.KEY_LAYOUT[row]``)."""
+        n = self
+        seen: set[int] = set()
+        while n is not None and id(n) not in seen:
+            seen.add(id(n))
+            if n.kind == "row":
+                return n.row
+            n = n.parents[0] if n.parents else None
+        return None
+
+
+@dataclasses.dataclass(eq=False)
+class Sink:
+    """One shaped ``random_bits`` draw and the key node feeding it."""
+
+    node: Node
+    shape: tuple
+    bit_width: int
+    nbytes: int
+    source: Source
+    path: tuple  # ((cond_site_id, branch_ix), ...) outermost-first
+    looped: bool  # key is loop-invariant across a scan/while body
+
+    def descr(self) -> str:
+        return self.node.descr()
+
+
+@dataclasses.dataclass(eq=False)
+class ProvenanceGraph:
+    """Everything keyscope's rules need about one traced entry."""
+
+    entry: str
+    sinks: list = dataclasses.field(default_factory=list)
+    folds: list = dataclasses.field(default_factory=list)  # every fold Node
+    roots: list = dataclasses.field(default_factory=list)  # every root Node
+
+    def sink_descrs(self) -> tuple:
+        """Sorted multiset of sink descriptors — the KB604 fingerprint."""
+        return tuple(sorted(s.descr() for s in self.sinks))
+
+
+# -- raw-key shadows ---------------------------------------------------------
+
+
+@dataclasses.dataclass(eq=False)
+class _Raw:
+    """Unwrapped u32 bytes of ``node`` (possibly the whole split block)."""
+
+    node: Node
+
+
+@dataclasses.dataclass(eq=False)
+class _RowRaw:
+    """Raw bytes of row ``row`` sliced out of an unwrapped split."""
+
+    split: Node
+    row: int
+
+
+# -- the walker --------------------------------------------------------------
+
+
+class _Walker:
+    def __init__(self, entry_name: str):
+        self.graph = ProvenanceGraph(entry_name)
+        self._splits: dict[tuple, Node] = {}  # (id(parent), m) -> Node
+        self._rows: dict[tuple, Node] = {}  # (id(split), row) -> Node
+        self._folds: dict[tuple, Node] = {}  # (id(parent), const) -> Node
+        self._cond_sites = 0
+
+    # -- node constructors (canonicalising) --
+
+    def _root(self, kind: str, taint: frozenset, src: Source) -> Node:
+        n = Node(kind, (), taint, src=src)
+        self.graph.roots.append(n)
+        return n
+
+    def _split(self, parent: Node, m: int, src: Source) -> Node:
+        key = (id(parent), m)
+        if key not in self._splits:
+            self._splits[key] = Node(
+                "split", (parent,), parent.taint, looped=parent.looped, m=m, src=src
+            )
+        return self._splits[key]
+
+    def _row_node(self, split: Node, row: int) -> Node:
+        key = (id(split), row)
+        if key not in self._rows:
+            self._rows[key] = Node(
+                "row", (split,), split.taint, looped=split.looped, row=row
+            )
+        return self._rows[key]
+
+    def _fold(self, parent: Node, const, taint: frozenset, fresh: bool, src: Source) -> Node:
+        if const is not None:
+            key = (id(parent), const)
+            if key not in self._folds:
+                n = Node(
+                    "fold", (parent,), parent.taint, looped=parent.looped,
+                    const=const, src=src,
+                )
+                self._folds[key] = n
+                self.graph.folds.append(n)
+            return self._folds[key]
+        # Traced fold operand: never canonicalised (values unknowable), and
+        # folding in per-iteration data (carry/xs-derived) breaks loop
+        # invariance.
+        n = Node(
+            "fold", (parent,), parent.taint | taint,
+            looped=parent.looped and not fresh, src=src,
+        )
+        self.graph.folds.append(n)
+        return n
+
+    # -- env helpers --
+
+    @staticmethod
+    def _read(env: dict, v):
+        return None if _literal_value(v) is not None else env["nodes"].get(v)
+
+    @staticmethod
+    def _taint_of(env: dict, v) -> frozenset:
+        if _literal_value(v) is not None:
+            return frozenset()
+        return env["taint"].get(v, frozenset())
+
+    @staticmethod
+    def _fresh_of(env: dict, v) -> bool:
+        if _literal_value(v) is not None:
+            return False
+        return v in env["fresh"]
+
+    def _rootify(self, env: dict, v, src: Source) -> Node:
+        """A key value with no tracked lineage: it *enters* here.
+
+        A var that reached us as a loop-body const is raw key bytes frozen
+        across iterations — root it looped (KB601's single-sink case)."""
+        taint = self._taint_of(env, v)
+        kind = "carried_key" if taint else "const_key"
+        n = self._root(kind, taint, src)
+        if v in env["loop_consts"]:
+            n.looped = True
+        env["nodes"][v] = n
+        return n
+
+    def _node_of(self, env: dict, v, src: Source) -> Node:
+        tracked = self._read(env, v)
+        if isinstance(tracked, Node):
+            return tracked
+        if isinstance(tracked, _Raw):
+            return tracked.node
+        if isinstance(tracked, _RowRaw):
+            return self._row_node(tracked.split, tracked.row)
+        return self._rootify(env, v, src)
+
+    # -- main walk --
+
+    def walk(self, jaxpr, env: dict, path: tuple) -> None:
+        for eqn in jaxpr.eqns:
+            self._eqn(jaxpr, eqn, env, path)
+
+    def _eqn(self, jaxpr, eqn, env: dict, path: tuple) -> None:
+        name = eqn.primitive.name
+        taint = frozenset().union(*(self._taint_of(env, v) for v in eqn.invars)) if eqn.invars else frozenset()
+        fresh = any(self._fresh_of(env, v) for v in eqn.invars)
+        for ov in eqn.outvars:
+            env["taint"][ov] = taint
+            if fresh:
+                env["fresh"].add(ov)
+
+        if name == "random_seed":
+            op = eqn.invars[0]
+            op_taint = self._taint_of(env, op)
+            kind = "counter_seed" if op_taint else "const_seed"
+            env["nodes"][eqn.outvars[0]] = self._root(kind, op_taint, source_of(eqn))
+            return
+        if name == "random_wrap":
+            tracked = self._read(env, eqn.invars[0])
+            if isinstance(tracked, _Raw):
+                env["nodes"][eqn.outvars[0]] = tracked.node
+            elif isinstance(tracked, _RowRaw):
+                env["nodes"][eqn.outvars[0]] = self._row_node(tracked.split, tracked.row)
+            elif isinstance(tracked, Node):
+                env["nodes"][eqn.outvars[0]] = tracked
+            elif _literal_value(eqn.invars[0]) is None:
+                # Root the *invar*, not the outvar: re-wrapping the same raw
+                # u32 var (every jax.random call wraps afresh) must alias to
+                # one node or identity-based KB601 goes blind.
+                env["nodes"][eqn.outvars[0]] = self._rootify(
+                    env, eqn.invars[0], source_of(eqn)
+                )
+            else:
+                self._rootify(env, eqn.outvars[0], source_of(eqn))
+            return
+        if name == "random_unwrap":
+            env["nodes"][eqn.outvars[0]] = _Raw(self._node_of(env, eqn.invars[0], source_of(eqn)))
+            return
+        if name == "random_split":
+            parent = self._node_of(env, eqn.invars[0], source_of(eqn))
+            shape = eqn.params.get("shape") or getattr(eqn.outvars[0].aval, "shape", (2,))
+            m = int(shape[0]) if shape else 2
+            env["nodes"][eqn.outvars[0]] = self._split(parent, m, source_of(eqn))
+            return
+        if name == "random_fold_in":
+            parent = self._node_of(env, eqn.invars[0], source_of(eqn))
+            data = eqn.invars[1]
+            const = _literal_value(data)
+            if const is None:
+                const = env["constval"].get(data)
+            if const is not None:
+                try:
+                    const = int(const)
+                except Exception:
+                    pass
+            env["nodes"][eqn.outvars[0]] = self._fold(
+                parent, const, self._taint_of(env, data), self._fresh_of(env, data),
+                source_of(eqn),
+            )
+            return
+        if name == "random_bits":
+            node = self._node_of(env, eqn.invars[0], source_of(eqn))
+            aval = getattr(eqn.outvars[0], "aval", None)
+            self.graph.sinks.append(
+                Sink(
+                    node,
+                    tuple(getattr(aval, "shape", ())),
+                    int(eqn.params.get("bit_width", 32)),
+                    aval_nbytes(aval) if aval is not None else 0,
+                    source_of(eqn),
+                    path,
+                    node.looped,
+                )
+            )
+            return
+
+        # Raw-byte plumbing: keep the u32 shadow alive through moves.
+        if name == "slice":
+            tracked = self._read(env, eqn.invars[0])
+            if isinstance(tracked, _Raw) and tracked.node.kind == "split":
+                start = eqn.params.get("start_indices", ())
+                limit = eqn.params.get("limit_indices", ())
+                if len(start) >= 1 and limit and int(limit[0]) == int(start[0]) + 1:
+                    env["nodes"][eqn.outvars[0]] = _RowRaw(tracked.node, int(start[0]))
+                    return
+            if tracked is not None:
+                env["nodes"][eqn.outvars[0]] = tracked
+            return
+        if name in _RAW_TRANSPARENT:
+            tracked = self._read(env, eqn.invars[0])
+            if tracked is not None:
+                env["nodes"][eqn.outvars[0]] = tracked
+            return
+        if name == "convert_element_type":
+            lit = _literal_value(eqn.invars[0])
+            if lit is None:
+                lit = env["constval"].get(eqn.invars[0])
+            if lit is not None:
+                env["constval"][eqn.outvars[0]] = lit
+            tracked = self._read(env, eqn.invars[0])
+            if tracked is not None:
+                env["nodes"][eqn.outvars[0]] = tracked
+            return
+
+        if name in _CALL_PRIMS:
+            self._call(eqn, env, path, name)
+            return
+        if name == "cond":
+            self._cond(eqn, env, path)
+            return
+        if name == "scan":
+            self._scan(eqn, env, path)
+            return
+        if name == "while":
+            self._while(eqn, env, path)
+            return
+
+    # -- higher-order primitives --
+
+    @staticmethod
+    def _inner(params_val):
+        return getattr(params_val, "jaxpr", params_val)  # ClosedJaxpr -> Jaxpr
+
+    def _seed_consts(self, inner, env: dict) -> None:
+        for cv in getattr(inner, "constvars", ()):
+            env["taint"].setdefault(cv, frozenset())
+
+    def _call(self, eqn, env: dict, path: tuple, name: str) -> None:
+        key = _CALL_PRIMS[name]
+        if key is None:  # identity-style (sharding_constraint)
+            tracked = self._read(env, eqn.invars[0])
+            if tracked is not None:
+                env["nodes"][eqn.outvars[0]] = tracked
+            return
+        sub = eqn.params.get(key)
+        if sub is None:
+            return
+        inner = self._inner(sub)
+        if not hasattr(inner, "eqns"):
+            return
+        for pos, v in enumerate(inner.invars):
+            if pos >= len(eqn.invars):
+                break
+            ov = eqn.invars[pos]
+            env["taint"][v] = self._taint_of(env, ov)
+            if self._fresh_of(env, ov):
+                env["fresh"].add(v)
+            tracked = self._read(env, ov)
+            if tracked is not None:
+                env["nodes"][v] = tracked
+            elif _is_key_aval(getattr(v, "aval", None)):
+                env["nodes"][v] = self._node_of(env, ov, source_of(eqn))
+        self._seed_consts(inner, env)
+        self.walk(inner, env, path)
+        for pos, ov in enumerate(eqn.outvars):
+            if pos >= len(inner.outvars):
+                break
+            iv = inner.outvars[pos]
+            env["taint"][ov] = self._taint_of(env, iv)
+            if self._fresh_of(env, iv):
+                env["fresh"].add(ov)
+            tracked = self._read(env, iv)
+            if tracked is not None:
+                env["nodes"][ov] = tracked
+
+    def _cond(self, eqn, env: dict, path: tuple) -> None:
+        branches = eqn.params.get("branches") or ()
+        site = self._cond_sites = self._cond_sites + 1
+        operands = eqn.invars[1:]  # invars = [index, *operands]
+        per_branch_outs: list[list] = []
+        for bi, br in enumerate(branches):
+            inner = self._inner(br)
+            if not hasattr(inner, "eqns"):
+                per_branch_outs.append([None] * len(eqn.outvars))
+                continue
+            for pos, v in enumerate(inner.invars):
+                if pos >= len(operands):
+                    break
+                ov = operands[pos]
+                env["taint"][v] = self._taint_of(env, ov)
+                if self._fresh_of(env, ov):
+                    env["fresh"].add(v)
+                tracked = self._read(env, ov)
+                if tracked is not None:
+                    env["nodes"][v] = tracked
+                elif _is_key_aval(getattr(v, "aval", None)):
+                    env["nodes"][v] = self._node_of(env, ov, source_of(eqn))
+            self._seed_consts(inner, env)
+            self.walk(inner, env, path + ((site, bi),))
+            outs = []
+            for iv in inner.outvars:
+                outs.append(self._read(env, iv))
+            per_branch_outs.append(outs)
+        for pos, ov in enumerate(eqn.outvars):
+            cands = [outs[pos] for outs in per_branch_outs if pos < len(outs)]
+            nodes = [c for c in cands if c is not None]
+            if not nodes:
+                continue
+            resolved = []
+            for c in nodes:
+                if isinstance(c, Node):
+                    resolved.append(c)
+                elif isinstance(c, _Raw):
+                    resolved.append(c.node)
+                elif isinstance(c, _RowRaw):
+                    resolved.append(self._row_node(c.split, c.row))
+            if not resolved:
+                continue
+            first = resolved[0]
+            if all(r is first for r in resolved) and len(resolved) == len(cands):
+                out_obj = nodes[0]
+            else:
+                out_obj = Node(
+                    "merge", tuple(resolved),
+                    frozenset().union(*(r.taint for r in resolved)),
+                    looped=all(r.looped for r in resolved),
+                )
+            env["nodes"][ov] = out_obj
+
+    def _loop_body(self, inner, eqn, env, path, n_consts: int, n_carry: int) -> list:
+        """Map a scan/while body's invars, walk it, return its out-tracks.
+
+        Consts keep their outer node (loop-invariant: ``looped`` flips on
+        any key node entering this way); carries become fresh per-iteration
+        ``carry`` nodes; xs slices are per-iteration fresh values."""
+        invars = list(inner.invars)
+        for pos, v in enumerate(invars):
+            if pos >= len(eqn.invars):
+                break
+            ov = eqn.invars[pos]
+            env["taint"][v] = self._taint_of(env, ov)
+            tracked = self._read(env, ov)
+            if pos < n_consts:
+                if tracked is None and _is_key_aval(getattr(v, "aval", None)):
+                    tracked = self._node_of(env, ov, source_of(eqn))
+                if tracked is not None:
+                    env["nodes"][v] = self._mark_looped(tracked)
+                else:
+                    # Possibly raw key bytes (untrackable until wrapped in
+                    # the body): remember the const-ness for _rootify.
+                    env["loop_consts"].add(v)
+                if self._fresh_of(env, ov):
+                    env["fresh"].add(v)
+            elif pos < n_consts + n_carry:
+                env["fresh"].add(v)
+                base = None
+                if tracked is None and _is_key_aval(getattr(v, "aval", None)):
+                    base = self._node_of(env, ov, source_of(eqn))
+                elif isinstance(tracked, Node):
+                    base = tracked
+                elif isinstance(tracked, _Raw):
+                    base = tracked.node
+                elif isinstance(tracked, _RowRaw):
+                    base = self._row_node(tracked.split, tracked.row)
+                if base is not None:
+                    carry = Node("carry", (base,), base.taint, src=source_of(eqn))
+                    env["nodes"][v] = _Raw(carry) if isinstance(tracked, (_Raw, _RowRaw)) else carry
+            else:
+                env["fresh"].add(v)
+        self._seed_consts(inner, env)
+        self.walk(inner, env, path)
+        return [self._read(env, iv) for iv in inner.outvars]
+
+    def _mark_looped(self, tracked):
+        def loop_node(n: Node) -> Node:
+            if n.looped:
+                return n
+            return Node(n.kind, n.parents, n.taint, looped=True, m=n.m, row=n.row, const=n.const, src=n.src)
+
+        if isinstance(tracked, Node):
+            return loop_node(tracked)
+        if isinstance(tracked, _Raw):
+            return _Raw(loop_node(tracked.node))
+        if isinstance(tracked, _RowRaw):
+            return tracked  # row canonicalisation would lose the flag; rare
+        return tracked
+
+    def _scan(self, eqn, env: dict, path: tuple) -> None:
+        inner = self._inner(eqn.params.get("jaxpr"))
+        if not hasattr(inner, "eqns"):
+            return
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        outs = self._loop_body(inner, eqn, env, path, n_consts, n_carry)
+        # eqn outvars = [final_carry x n_carry, stacked ys]; body outvars
+        # = [carry x n_carry, ys]. Final carry keeps the body's node (one
+        # more iteration of the same chain); ys get stacked.
+        for pos, ov in enumerate(eqn.outvars):
+            if pos >= len(outs):
+                break
+            tracked = outs[pos]
+            if tracked is None:
+                continue
+            if pos < n_carry:
+                env["nodes"][ov] = tracked
+            else:
+                if isinstance(tracked, _Raw):
+                    base = tracked.node
+                elif isinstance(tracked, _RowRaw):
+                    base = self._row_node(tracked.split, tracked.row)
+                else:
+                    base = tracked
+                stack = Node("stack", (base,), base.taint)
+                env["nodes"][ov] = _Raw(stack) if isinstance(tracked, (_Raw, _RowRaw)) else stack
+
+    def _while(self, eqn, env: dict, path: tuple) -> None:
+        cond_j = self._inner(eqn.params.get("cond_jaxpr"))
+        body_j = self._inner(eqn.params.get("body_jaxpr"))
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        n_carry = len(eqn.invars) - cn - bn
+        if hasattr(cond_j, "eqns"):
+            # cond sees [cond_consts..., carry...]: remap a pseudo-eqn view.
+            class _V:  # noqa: N801 - tiny positional shim
+                invars = list(eqn.invars[:cn]) + list(eqn.invars[cn + bn:])
+            self._loop_body(cond_j, _V, env, path, cn, n_carry)
+        if hasattr(body_j, "eqns"):
+            class _W:  # noqa: N801
+                invars = list(eqn.invars[cn:cn + bn]) + list(eqn.invars[cn + bn:])
+            outs = self._loop_body(body_j, _W, env, path, bn, n_carry)
+            for pos, ov in enumerate(eqn.outvars):
+                if pos < len(outs) and outs[pos] is not None:
+                    env["nodes"][ov] = outs[pos]
+
+
+def build_provenance(entry_name: str, closed_jaxpr) -> ProvenanceGraph:
+    """Walk one traced entry and return its key-provenance graph."""
+    walker = _Walker(entry_name)
+    jaxpr = closed_jaxpr.jaxpr
+    env: dict = {
+        "taint": {}, "nodes": {}, "constval": {}, "fresh": set(),
+        "loop_consts": set(),
+    }
+    for i, v in enumerate(jaxpr.invars):
+        env["taint"][v] = frozenset({i})
+        if _is_key_aval(getattr(v, "aval", None)):
+            env["nodes"][v] = walker._root("carried_key", frozenset({i}), Source("<arg>", 0))
+    for cv in getattr(jaxpr, "constvars", ()):
+        env["taint"][cv] = frozenset()
+    walker.walk(jaxpr, env, ())
+    return walker.graph
+
+
+def iter_sinks(graphs) -> Iterator[Sink]:
+    for g in graphs:
+        yield from g.sinks

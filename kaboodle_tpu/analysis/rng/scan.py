@@ -1,0 +1,254 @@
+"""The keyscope driver: trace, build provenance, rule, bank the leap report.
+
+``run_rng_scan`` mirrors graftscan's ``run_scan``: trace every selected
+registry entry (x32 — the production program), build its key-provenance
+graph, run the per-graph KB601-603 checks, the trace-free KB602 registry
+comparison and the cross-entry KB604 fingerprint check, and return ALL
+findings pre-baseline so the CLI applies the shared shrink-only plumbing.
+
+The leap report (KB605's artifact) is a deterministic JSON classifying
+every draw sink in every engine — site, shape, leapability class,
+KEY_LAYOUT row, warp signature terms, draw bytes — joined with the
+costscope per-entry ``bytes_accessed`` so the chain-coupled sites of the
+dense drain seasons arrive byte-attributed: ROADMAP item 2's migration
+worklist, measured instead of guessed. ``leap_findings`` gates freshness
+against the committed copy (``make rng-dryrun`` / CI), same ratchet shape
+as the costscope baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Sequence
+
+from kaboodle_tpu.analysis.core import Finding
+from kaboodle_tpu.analysis.ir.registry import EntryPoint, select_entries, trace_entry
+from kaboodle_tpu.analysis.ir.scan import _prepare_backend
+from kaboodle_tpu.analysis.rng import rules
+from kaboodle_tpu.analysis.rng.provenance import ProvenanceGraph, build_provenance
+
+DEFAULT_LEAP_REPORT = "KEYSCOPE_LEAP.json"
+LEAP_SCHEMA = "kaboodle-keyscope-leap/1"
+_LEAP_PATH = "rng://leap-report"
+
+
+@dataclasses.dataclass
+class RngScanResult:
+    findings: list[Finding]
+    graphs: dict[str, ProvenanceGraph]
+    entries_scanned: int
+
+
+def run_rng_scan(
+    entry_names: Sequence[str] | None = None,
+    entries: Sequence[EntryPoint] | None = None,
+    progress=None,
+) -> RngScanResult:
+    """Audit the registry's key provenance (or an injected subset)."""
+    _prepare_backend()
+    chosen = entries if entries is not None else select_entries(entry_names)
+    findings: list[Finding] = list(rules.check_kb602_stream_registry())
+    graphs: dict[str, ProvenanceGraph] = {}
+    for entry in chosen:
+        if progress:
+            progress(f"keyscope: tracing {entry.name}")
+        graph = build_provenance(entry.name, trace_entry(entry, x64=False))
+        graphs[entry.name] = graph
+        for check in rules.PER_GRAPH_CHECKS:
+            findings.extend(check(graph))
+    findings.extend(rules.check_kb604_chain_divergence(graphs))
+    findings.sort(key=lambda f: (f.path, f.rule, f.symbol))
+    return RngScanResult(findings, graphs, len(chosen))
+
+
+# -- the leap report ---------------------------------------------------------
+
+
+def _layout_name(sink) -> str | None:
+    """KEY_LAYOUT row name of a dense-chain sink (None off the chain)."""
+    from kaboodle_tpu.phasegraph.ops import KEY_LAYOUT
+
+    if "carried_key" not in sink.node.roots():
+        return None
+    row = sink.node.layout_row()
+    if row is None or row >= len(KEY_LAYOUT):
+        return None
+    return KEY_LAYOUT[row]
+
+
+def build_leap_report(
+    graphs: dict[str, ProvenanceGraph],
+    costscope_path: str | Path | None = None,
+) -> dict:
+    """Deterministic KB605 classification of every sink in ``graphs``.
+
+    No timestamps, no ids — two runs over the same code produce the same
+    bytes, which is what lets CI diff the committed copy."""
+    cost: dict[str, int] = {}
+    if costscope_path is not None:
+        try:
+            from kaboodle_tpu.costscope.baseline import load_baseline
+
+            data = load_baseline(costscope_path)
+            if data is not None:
+                cost = {
+                    name: int(rec.get("bytes_accessed", 0))
+                    for name, rec in data["entries"].items()
+                }
+        except Exception:
+            cost = {}
+
+    entries: dict[str, dict] = {}
+    totals = {
+        rules.CLASS_CHAIN: 0,
+        rules.CLASS_COUNTER: 0,
+        rules.CLASS_IMPURE: 0,
+        "chain_coupled_draw_bytes": 0,
+    }
+    for name in sorted(graphs):
+        graph = graphs[name]
+        sinks = []
+        counts = {rules.CLASS_CHAIN: 0, rules.CLASS_COUNTER: 0, rules.CLASS_IMPURE: 0}
+        for s in sorted(
+            graph.sinks, key=lambda s: (s.source.file, s.source.line, s.descr())
+        ):
+            cls = rules.classify(s)
+            counts[cls] += 1
+            totals[cls] += 1
+            if cls == rules.CLASS_CHAIN:
+                totals["chain_coupled_draw_bytes"] += s.nbytes
+            layout = _layout_name(s)
+            sinks.append(
+                {
+                    "site": s.source.render(),
+                    "key": s.descr(),
+                    "shape": list(s.shape),
+                    "bit_width": s.bit_width,
+                    "draw_bytes": s.nbytes,
+                    "class": cls,
+                    "roots": sorted(s.node.roots()),
+                    "layout_row": layout,
+                    "warp_terms": list(rules.WARP_TERMS.get(layout, ()))
+                    if layout
+                    else [],
+                }
+            )
+        entries[name] = {
+            "sinks": sinks,
+            "chain_coupled": counts[rules.CLASS_CHAIN],
+            "counter_keyed": counts[rules.CLASS_COUNTER],
+            "impure": counts[rules.CLASS_IMPURE],
+            "entry_bytes_accessed": cost.get(name, 0),
+        }
+    return {
+        "schema": LEAP_SCHEMA,
+        "streams": {name: sid for name, sid in rules.KEYSCOPE_STREAMS},
+        "entries": entries,
+        "totals": totals,
+    }
+
+
+def write_leap_report(report: dict, path: str | Path = DEFAULT_LEAP_REPORT) -> None:
+    Path(path).write_text(json.dumps(report, indent=1, sort_keys=True) + "\n")
+
+
+def load_leap_report(path: str | Path = DEFAULT_LEAP_REPORT) -> dict | None:
+    p = Path(path)
+    if not p.exists():
+        return None
+    data = json.loads(p.read_text())
+    if not isinstance(data, dict) or data.get("schema") != LEAP_SCHEMA:
+        raise ValueError(f"{p}: not a {LEAP_SCHEMA} report")
+    return data
+
+
+def leap_findings(
+    graphs: dict[str, ProvenanceGraph],
+    path: str | Path = DEFAULT_LEAP_REPORT,
+    costscope_path: str | Path | None = None,
+) -> list[Finding]:
+    """KB605 freshness gate: regenerate and diff against the committed copy.
+
+    Only meaningful on a full-registry run — the caller (CLI) skips it for
+    scoped ``--entries`` invocations. NOT baselineable: a stale report is
+    fixed by regenerating it, never by justifying the staleness."""
+    committed = load_leap_report(path)
+    if committed is None:
+        return [
+            Finding(
+                _LEAP_PATH,
+                "KB605",
+                0,
+                f"no committed leap report at {path} — run the rng lane "
+                "with --write-leap and commit it",
+                "missing",
+            )
+        ]
+    live = build_leap_report(graphs, costscope_path=costscope_path)
+    if committed == live:
+        return []
+    stale = []
+    c_entries, l_entries = committed.get("entries", {}), live["entries"]
+    for name in sorted(set(c_entries) | set(l_entries)):
+        if c_entries.get(name) != l_entries.get(name):
+            stale.append(name)
+    detail = f"entries differ: {stale[:6]}" if stale else "header/totals differ"
+    return [
+        Finding(
+            _LEAP_PATH,
+            "KB605",
+            0,
+            f"committed leap report is stale ({detail}) — the draw sites "
+            "moved under it; regenerate with --write-leap and commit",
+            "stale",
+        )
+    ]
+
+
+def render_leap_report(report: dict) -> str:
+    """Human table: per-entry class counts, then every chain-coupled site.
+
+    The second half IS the item-2 worklist: which draws must move to the
+    counter discipline before the drain seasons can leap, ordered by the
+    bytes their entry touches per dispatch."""
+    lines = ["keyscope leap report — draw-sink leapability by entry", ""]
+    lines.append(
+        f"{'entry':40s} {'chain':>6s} {'counter':>8s} {'impure':>7s} "
+        f"{'entry bytes/dispatch':>21s}"
+    )
+    for name, rec in sorted(report["entries"].items()):
+        lines.append(
+            f"{name:40s} {rec['chain_coupled']:6d} {rec['counter_keyed']:8d} "
+            f"{rec['impure']:7d} {rec['entry_bytes_accessed']:21,d}"
+        )
+    totals = report["totals"]
+    lines.append("")
+    lines.append(
+        f"totals: {totals[rules.CLASS_CHAIN]} chain-coupled / "
+        f"{totals[rules.CLASS_COUNTER]} counter-keyed / "
+        f"{totals[rules.CLASS_IMPURE]} impure sinks; "
+        f"{totals['chain_coupled_draw_bytes']:,d} chain-coupled draw bytes "
+        "per full-registry dispatch"
+    )
+    chain_sites: dict[tuple, list] = {}
+    for name, rec in sorted(report["entries"].items()):
+        for s in rec["sinks"]:
+            if s["class"] != rules.CLASS_CHAIN:
+                continue
+            key = (s["site"], s["layout_row"], tuple(s["warp_terms"]))
+            chain_sites.setdefault(key, []).append((name, s["draw_bytes"]))
+    lines.append("")
+    lines.append("chain-coupled sites (ROADMAP item 2's re-keying worklist):")
+    for (site, layout, terms), users in sorted(
+        chain_sites.items(), key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2])
+    ):
+        row = f"row={layout}" if layout else "row=?"
+        term_s = ",".join(terms) or "-"
+        total_bytes = sum(b for _, b in users)
+        lines.append(
+            f"  {site:28s} {row:10s} terms={term_s:22s} "
+            f"entries={len(users):2d} draw_bytes={total_bytes:,d}"
+        )
+    return "\n".join(lines)

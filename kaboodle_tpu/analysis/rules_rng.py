@@ -1,0 +1,143 @@
+"""KB6xx — keyscope: PRNG key-provenance rules over the traced programs.
+
+Like the KB4xx family, this module registers only the rule
+*documentation* (``--explain KB6nn``, ``--list-rules``) with no-op AST
+checks, so the default AST lane stays jax-free. The provenance engine and
+the actual checks live in ``kaboodle_tpu/analysis/rng/`` and run under
+``python -m kaboodle_tpu.analysis --rng`` over the same entry-point
+registry graftscan traces.
+
+Suppression is the justified ``.keyscope_baseline.json`` (shrink-only,
+same contract as the other three debt files). KB605's leap-report
+freshness findings are NOT baselineable — a stale report is fixed by
+regenerating it (``--write-leap``), never by justifying the staleness.
+"""
+
+from __future__ import annotations
+
+from kaboodle_tpu.analysis.core import rule
+
+
+def _rng_only(mod):
+    """KB6xx rules run on key-provenance graphs (analysis/rng/), not ASTs."""
+    return []
+
+
+rule(
+    "KB601",
+    "key reuse: two reachable draws on one unforked key",
+    """
+Two `random_bits` sinks in one traced program whose key provenance reaches
+the same canonical key node — the same split row, the same fold chain —
+without an intervening `split`/`fold_in`. Identical threefry keys produce
+identical streams: the two "independent" draws are bit-equal, which skews
+every statistic built on them and (for delivery bernoullis) correlates
+phases the protocol model assumes independent.
+
+This is the runtime generalization of the AST lane's KB204: the source may
+fork keys through helpers the AST cannot follow, but the traced program
+cannot hide a shared node. Sinks in *different branches of the same
+`lax.cond`* are mutually exclusive at runtime and do NOT count (the
+dispatched dense build keeps its full and fused programs under one cond,
+both consuming the same layout rows). A loop-invariant key drawn inside a
+`scan`/`while` body fires too — every iteration redraws the same stream.
+
+Fix by forking per consumer: another KEY_LAYOUT row (dense chain) or a
+fresh STREAM_* id (counter chain). Baseline only draws that are provably
+never live together beyond what the cond structure already shows.
+""",
+)(_rng_only)
+
+
+rule(
+    "KB602",
+    "stream-id collision / STREAM_* registry drift",
+    """
+Two ledgers, compared mechanically on every rng run:
+
+1. *In-trace*: two `fold_in` constants colliding on the same canonical
+   parent key (two phases drawing the same stream), or a literal fold on a
+   counter-seed chain using an id absent from the registered STREAM_*
+   set (a phase drawing off the books).
+2. *Registry*: the live `sparseplane/rng.py` STREAM_* constants vs
+   keyscope's pinned KEYSCOPE_STREAMS table (analysis/rng/rules.py). Ids
+   must be value-identical, dense from 0, and append-only-ordered. A
+   *swap* of two ids keeps the traced constants collision-free and
+   set-equal — only this double-entry comparison catches it before every
+   banked run's draws silently renumber.
+
+"New phases append" is rng.py's stated contract; this rule is its
+mechanical form: appending edits both ledgers, renumbering trips the lane.
+""",
+)(_rng_only)
+
+
+rule(
+    "KB603",
+    "resume-impure draw: provenance outside the checkpointable state",
+    """
+A draw whose key provenance does not bottom out in the entry point's
+arguments — a `PRNGKey(0)` (or any constant seed/key) baked into the
+traced program. Such a draw replays identically on every resume and every
+process, regardless of the checkpoint: restarts stop being bit-exact
+continuations, A/B seeds silently share randomness, and the
+fast-forwarding lever (PAPERS.md 2602.10615) mis-replays the stream.
+
+This is the exact property sparseplane's `(seed, cursor)` counter
+discipline claims by construction — here it is proven for every engine:
+dense chains must root in the carried state key (`MeshState.key`, a
+checkpointed plane), counter chains in the argument-fed seed/cursor.
+
+Fix by threading the key material through the state planes. A baseline
+entry is almost never justified — constant keys belong in tests only, and
+test jaxprs are not registry entries.
+""",
+)(_rng_only)
+
+
+rule(
+    "KB604",
+    "cross-engine key-chain divergence",
+    """
+Engines derived from the same phasegraph op table, with bit-exactness
+pinned by the phasegraph dryrun, must fork keys identically: their
+provenance fingerprints (the sorted multiset of sink descriptors, e.g.
+`carried_key/split5[3]`) must be equal. A divergence — one engine drawing
+a row its twin never touches — is the compile-time shadow of a numeric
+diff: the bit-compare will catch it at runtime, this rule catches it at
+trace time and names the draw.
+
+The pinned groups live in analysis/rng/rules.py CHAIN_GROUPS (dense drop
+engines; draw-free dense configs; blocked pair; fleet trio; sparse pair;
+the draw-free leap and serve-step families). Entries outside the groups
+have *declared* divergent fates (tick.random's fully randomized config,
+blocked's per-block folds). Growing or splitting a group is a deliberate
+edit to that table, reviewed like a baseline change.
+""",
+)(_rng_only)
+
+
+rule(
+    "KB605",
+    "leapability: chain-coupled vs counter-keyed draw sites",
+    """
+Not a pass/fail property of the code but of the *artifact*: every draw
+sink in every engine is classified
+
+- `counter_keyed` — provenance bottoms out ONLY in `random_seed` on
+  argument-derived counters (sparseplane's discipline). The draw is a pure
+  function of checkpointable `(seed, cursor)` state: memoization /
+  fast-forwarding (PAPERS.md 2602.10615) can leap over it for free.
+- `chain_coupled` — a carried split-chain key feeds it: reproducing tick
+  T's draw requires advancing the chain T times. These are the draws that
+  keep the dense drain seasons dense — ROADMAP item 2's 13.25x-vs-1.61x
+  gap lives exactly here.
+
+`keyscope --leap-report` renders the classification with per-site byte
+attribution (costscope `bytes_accessed` join) and the warp signature
+terms each dense-chain row serves; `--write-leap` banks it as
+KEYSCOPE_LEAP.json and `--check-leap` (make rng-dryrun / CI) fails when
+the committed copy goes stale. Freshness findings are not baselineable —
+regenerate instead.
+""",
+)(_rng_only)

@@ -69,6 +69,7 @@ import jax.numpy as jnp
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.hashing import fingerprint_agreement, peer_record_hash
 from kaboodle_tpu.phasegraph.graph import build_graph
+from kaboodle_tpu.phasegraph.ops import split_tick_keys
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.ops.sampling import (
     _stable_k_smallest_iter,
@@ -193,7 +194,7 @@ def make_chunked_tick_fn(
 
         t = st.tick
         idx = jnp.arange(n, dtype=jnp.int32)
-        key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
+        key_proxy, key_ping, key_bern, key_drop, key_next = split_tick_keys(st.key)
 
         S, T = st.state, st.timer
         tT = t.astype(T.dtype)

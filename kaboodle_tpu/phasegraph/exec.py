@@ -106,6 +106,7 @@ from kaboodle_tpu.ops.sampling import (
     choose_one_of_oldest_k,
 )
 from kaboodle_tpu.phasegraph.graph import build_graph
+from kaboodle_tpu.phasegraph.ops import split_tick_keys
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
@@ -289,7 +290,7 @@ def make_tick_fn(
         t = st.tick
         idx = jnp.arange(n, dtype=jnp.int32)
         eye = idx[:, None] == idx[None, :]
-        key_proxy, key_ping, key_bern, key_drop, key_next = jax.random.split(st.key, 5)
+        key_proxy, key_ping, key_bern, key_drop, key_next = split_tick_keys(st.key)
 
         S, T = st.state, st.timer
         # Timer writes must stay in the timer's dtype (int32 default, int16

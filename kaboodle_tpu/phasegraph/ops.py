@@ -89,6 +89,27 @@ BLOCKED_PLANES = {
 # PhaseOp.sparse vocabulary: the op's fate in a blocked_topk build.
 SPARSE_FATES = ("row", "block", "absent")
 
+# The dense 5-way key layout: what each row of the per-tick
+# ``split(key, 5)`` feeds (the ``rng_split`` op below). Every dense
+# engine — exec.py's kernel, blocked.py's chunked twin, span.py's leap
+# key chain — consumes the SAME rows in the SAME order, or their
+# bit-exactness diff breaks; this tuple is the single authority, and
+# keyscope (analysis/rng/) names draw sinks by these rows. Reordering or
+# appending here is a provenance-visible event, never a silent desync.
+KEY_LAYOUT = ("proxy", "ping", "bern", "drop", "next")
+KEY_PROXY, KEY_PING, KEY_BERN, KEY_DROP, KEY_NEXT = range(len(KEY_LAYOUT))
+
+
+def split_tick_keys(key):
+    """One tick's key fork: ``split(key, 5)`` rows in KEY_LAYOUT order.
+
+    Returns ``(key_proxy, key_ping, key_bern, key_drop, key_next)``; the
+    carried key is the ``next`` row whatever happens this tick. jax is
+    imported locally — this module stays importable as pure metadata."""
+    import jax
+
+    return tuple(jax.random.split(key, len(KEY_LAYOUT)))
+
 
 @dataclasses.dataclass(frozen=True)
 class PhaseOp:

@@ -70,6 +70,7 @@ from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.hashing import membership_fingerprint
 from kaboodle_tpu.ops.sampling import pick_candidate
 from kaboodle_tpu.phasegraph.graph import build_graph
+from kaboodle_tpu.phasegraph.ops import KEY_NEXT, KEY_PING, split_tick_keys
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.sim.state import MeshState
 from kaboodle_tpu.spec import KNOWN
@@ -251,12 +252,12 @@ def make_leap_fn(
 
         if not masked:
             # ---- the [k, ...] draw batch (counter-based PRNG) -------------
-            # Key chain: the dense tick derives (proxy, ping, bern, drop,
-            # next) from split(key, 5) and carries row 4; only the ping key
+            # Key chain: the dense tick derives ops.KEY_LAYOUT rows from
+            # split(key, 5) and carries the `next` row; only the ping key
             # is ever consumed on a quiescent tick.
             def key_step(key, _):
-                ks = jax.random.split(key, 5)
-                return ks[4], ks[1]
+                ks = split_tick_keys(key)
+                return ks[KEY_NEXT], ks[KEY_PING]
 
             key_final, ping_keys = jax.lax.scan(key_step, st.key, None, length=k)
             ticks = st.tick + jnp.arange(k, dtype=jnp.int32)  # [k] in-span ticks
@@ -286,12 +287,12 @@ def make_leap_fn(
             if masked:
                 step = x
                 active = step < k_m
-                ks = jax.random.split(key, 5)
-                key = jnp.where(active, ks[4], key)
+                ks = split_tick_keys(key)
+                key = jnp.where(active, ks[KEY_NEXT], key)
                 t = st.tick + step
                 u_t = (
                     None if det
-                    else jax.random.uniform(ks[1], (n,), dtype=jnp.float32)
+                    else jax.random.uniform(ks[KEY_PING], (n,), dtype=jnp.float32)
                 )
             else:
                 t, u_t = x

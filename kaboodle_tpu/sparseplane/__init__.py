@@ -21,12 +21,28 @@ from kaboodle_tpu.sparseplane.state import (
     sparse_fingerprint,
 )
 from kaboodle_tpu.sparseplane.kernel import make_sparse_tick_fn
+from kaboodle_tpu.sparseplane.rng import (
+    STREAM_ACK,
+    STREAM_CHAIN,
+    STREAM_DRAW,
+    STREAM_GOSSIP,
+    STREAM_PING,
+    STREAM_PROXY,
+    stream_table,
+)
 from kaboodle_tpu.sparseplane.runner import (
     simulate_sparse,
     run_sparse_until_converged,
 )
 
 __all__ = [
+    "STREAM_ACK",
+    "STREAM_CHAIN",
+    "STREAM_DRAW",
+    "STREAM_GOSSIP",
+    "STREAM_PING",
+    "STREAM_PROXY",
+    "stream_table",
     "SparseSpec",
     "SparseState",
     "SparseTickInputs",

@@ -30,6 +30,24 @@ STREAM_ACK = 4  # ack delivery bernoulli
 STREAM_GOSSIP = 5  # piggyback share slot picks
 
 
+def stream_table() -> dict[str, int]:
+    """Live ``{name: id}`` view of every ``STREAM_*`` constant, in id order.
+
+    Read off the module's attributes at call time (not a frozen copy), so
+    keyscope's double-entry check (analysis/rng/rules.py
+    ``KEYSCOPE_STREAMS``) sees exactly what the kernel will fold in —
+    including any renumbering a bad edit (or a mutation test) introduces."""
+    import sys
+
+    mod = sys.modules[__name__]
+    table = {
+        name: getattr(mod, name)
+        for name in dir(mod)
+        if name.startswith("STREAM_") and isinstance(getattr(mod, name), int)
+    }
+    return dict(sorted(table.items(), key=lambda kv: (kv[1], kv[0])))
+
+
 def stream_key(seed: jax.Array, cursor: jax.Array, stream: int) -> jax.Array:
     """Threefry key for one phase of one tick — pure function of the counters."""
     base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)

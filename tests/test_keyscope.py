@@ -1,0 +1,452 @@
+"""keyscope (kaboodle_tpu.analysis.rng) — provenance, rules, mutations.
+
+The acceptance contract for the rng lane is mutation-tested, mirroring
+the graftscan/graftconc harnesses: each seeded regression the ISSUE
+names — (a) key_ping reused for the bern draw, (b) two STREAM_* ids
+swapped, (c) a fresh PRNGKey(0) threaded into the sparse kernel past the
+cursor — must turn the gate red through BOTH routes: in-process
+``cli.main`` (registry traced live, so monkeypatches are visible) and the
+``python -m kaboodle_tpu.analysis --rng`` subprocess CI actually runs
+(textual mutations of a shadow package tree that wins the import path).
+Unit coverage of the provenance engine runs on tiny synthetic jaxprs.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaboodle_tpu.analysis.cli import main
+from kaboodle_tpu.analysis.rng import rules as rng_rules
+from kaboodle_tpu.analysis.rng import scan as rng_scan
+from kaboodle_tpu.analysis.rng.provenance import build_provenance
+from kaboodle_tpu.phasegraph import ops as pg_ops
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _graph(fn, *args, name="test.fn"):
+    return build_provenance(name, jax.make_jaxpr(fn)(*args))
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# satellite: the hoisted KEY_LAYOUT (phasegraph/ops.py)
+
+
+def test_key_layout_pinned():
+    assert pg_ops.KEY_LAYOUT == ("proxy", "ping", "bern", "drop", "next")
+    assert (
+        pg_ops.KEY_PROXY, pg_ops.KEY_PING, pg_ops.KEY_BERN,
+        pg_ops.KEY_DROP, pg_ops.KEY_NEXT,
+    ) == (0, 1, 2, 3, 4)
+
+
+def test_split_tick_keys_matches_raw_split():
+    key = jax.random.PRNGKey(7)
+    ks = pg_ops.split_tick_keys(key)
+    assert len(ks) == len(pg_ops.KEY_LAYOUT)
+    np.testing.assert_array_equal(
+        np.stack([np.asarray(k) for k in ks]),
+        np.asarray(jax.random.split(key, 5)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: the pinned STREAM_* registry (sparseplane)
+
+
+def test_stream_registry_pinned_and_exported():
+    import kaboodle_tpu.sparseplane as sp
+
+    table = sp.stream_table()
+    # Double-entry bookkeeping: the live module and keyscope's own table
+    # must agree entry-for-entry, in id order.
+    assert list(table.items()) == list(rng_rules.KEYSCOPE_STREAMS)
+    ids = list(table.values())
+    assert ids == list(range(len(ids)))  # dense from 0, append-only order
+    assert sp.STREAM_PROXY == 0
+    assert sp.STREAM_GOSSIP == len(ids) - 1
+    assert rng_rules.check_kb602_stream_registry() == []
+
+
+def test_stream_registry_drift_detected(monkeypatch):
+    import kaboodle_tpu.sparseplane.rng as sprng
+
+    monkeypatch.setattr(sprng, "STREAM_PING", sprng.STREAM_ACK)
+    findings = rng_rules.check_kb602_stream_registry()
+    assert "KB602" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# provenance engine — synthetic programs
+
+
+def test_dense_chain_rows_and_classes():
+    def f(key):
+        kp, kq = jax.random.split(key, 2)
+        return jax.random.uniform(kp, (4,)) + jax.random.uniform(kq, (4,))
+
+    g = _graph(f, jax.random.PRNGKey(0))
+    assert sorted(s.descr() for s in g.sinks) == [
+        "carried_key/split2[0]",
+        "carried_key/split2[1]",
+    ]
+    assert all(rng_rules.classify(s) == rng_rules.CLASS_CHAIN for s in g.sinks)
+    assert rng_rules.check_kb601_key_reuse(g) == []
+
+
+def test_counter_chain_classified_counter_keyed():
+    def f(seed, cursor):
+        k = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(seed), cursor), jnp.uint32(3))
+        return jax.random.uniform(k, (4,))
+
+    g = _graph(f, jnp.uint32(1), jnp.uint32(2))
+    (sink,) = g.sinks
+    assert sink.descr() == "counter_seed/fold[?]/fold[3]"
+    assert rng_rules.classify(sink) == rng_rules.CLASS_COUNTER
+    assert rng_rules.check_kb603_resume_impurity(g) == []
+
+
+def test_kb601_same_key_drawn_twice():
+    def f(key):
+        return jax.random.uniform(key, (4,)) + jax.random.uniform(key, (4,))
+
+    findings = rng_rules.check_kb601_key_reuse(_graph(f, jax.random.PRNGKey(0)))
+    assert rules_of(findings) == {"KB601"}
+
+
+def test_kb601_cond_branches_are_exclusive():
+    # The dispatched dense build's shape: full and fused programs under one
+    # lax.cond, both drawing the same key — mutually exclusive, NOT reuse.
+    def f(pred, key):
+        return jax.lax.cond(
+            pred,
+            lambda k: jax.random.uniform(k, (4,)),
+            lambda k: jax.random.uniform(k, (4,)) * 2.0,
+            key,
+        )
+
+    g = _graph(f, jnp.bool_(True), jax.random.PRNGKey(0))
+    assert len(g.sinks) == 2
+    assert rng_rules.check_kb601_key_reuse(g) == []
+
+
+def test_kb601_loop_invariant_key_in_scan():
+    def f(key):
+        def body(c, _):
+            return c + jnp.sum(jax.random.uniform(key, (4,))), None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0), None, length=3)
+        return out
+
+    findings = rng_rules.check_kb601_key_reuse(_graph(f, jax.random.PRNGKey(0)))
+    assert rules_of(findings) == {"KB601"}
+    assert any("loop-invariant" in f.message for f in findings)
+
+
+def test_kb601_carried_key_in_scan_is_clean():
+    # The span.py shape: split each iteration, draw one row, carry another.
+    def f(key):
+        def body(k, _):
+            ks = jax.random.split(k, 5)
+            return ks[4], jax.random.uniform(ks[1], (4,))
+
+        _, ys = jax.lax.scan(body, key, None, length=3)
+        return ys
+
+    g = _graph(f, jax.random.PRNGKey(0))
+    assert rng_rules.check_kb601_key_reuse(g) == []
+    assert all(not s.looped for s in g.sinks)
+
+
+def test_kb602_colliding_stream_constants():
+    def f(seed, cursor):
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+        a = jax.random.uniform(jax.random.fold_in(base, jnp.uint32(2)), (4,))
+        base2 = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+        b = jax.random.uniform(jax.random.fold_in(base2, jnp.uint32(2)), (4,))
+        return a + b
+
+    findings = rng_rules.check_kb602_stream_collision(
+        _graph(f, jnp.uint32(1), jnp.uint32(2))
+    )
+    assert "KB602" in rules_of(findings)
+    assert any("collide" in f.symbol for f in findings)
+
+
+def test_kb602_unregistered_stream_id():
+    def f(seed, cursor):
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+        return jax.random.uniform(jax.random.fold_in(base, jnp.uint32(77)), (4,))
+
+    findings = rng_rules.check_kb602_stream_collision(
+        _graph(f, jnp.uint32(1), jnp.uint32(2))
+    )
+    assert any(f.symbol == "unregistered:77" for f in findings)
+
+
+def test_kb603_const_seed_draw():
+    def f(x):
+        return x + jax.random.uniform(jax.random.PRNGKey(0), (4,))
+
+    findings = rng_rules.check_kb603_resume_impurity(
+        _graph(f, jnp.zeros((4,), jnp.float32))
+    )
+    assert rules_of(findings) == {"KB603"}
+
+
+def test_kb604_group_divergence(monkeypatch):
+    def one(key):
+        kp, _ = jax.random.split(key, 2)
+        return jax.random.uniform(kp, (4,))
+
+    def other(key):
+        kp, kq = jax.random.split(key, 2)
+        return jax.random.uniform(kp, (4,)) + jax.random.uniform(kq, (4,))
+
+    graphs = {
+        "eng.a": _graph(one, jax.random.PRNGKey(0), name="eng.a"),
+        "eng.b": _graph(other, jax.random.PRNGKey(0), name="eng.b"),
+    }
+    monkeypatch.setattr(
+        rng_rules, "CHAIN_GROUPS", (("pair", ("eng.a", "eng.b")),)
+    )
+    findings = rng_rules.check_kb604_chain_divergence(graphs)
+    assert rules_of(findings) == {"KB604"}
+    # A scoped scan with one member present skips the group.
+    assert rng_rules.check_kb604_chain_divergence({"eng.a": graphs["eng.a"]}) == []
+
+
+# ---------------------------------------------------------------------------
+# the leap report
+
+
+def _toy_graphs():
+    def dense(key):
+        ks = jax.random.split(key, 5)
+        return jax.random.uniform(ks[1], (4,))
+
+    def sparse(seed, cursor):
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+        return jax.random.uniform(jax.random.fold_in(base, jnp.uint32(3)), (4,))
+
+    return {
+        "toy.dense": _graph(dense, jax.random.PRNGKey(0), name="toy.dense"),
+        "toy.sparse": _graph(sparse, jnp.uint32(1), jnp.uint32(2), name="toy.sparse"),
+    }
+
+
+def test_leap_report_classifies_and_is_deterministic():
+    graphs = _toy_graphs()
+    r1 = rng_scan.build_leap_report(graphs)
+    r2 = rng_scan.build_leap_report(graphs)
+    assert r1 == r2  # byte-deterministic: CI diffs the committed copy
+    assert r1["schema"] == rng_scan.LEAP_SCHEMA
+    dense = r1["entries"]["toy.dense"]
+    assert dense["chain_coupled"] == 1 and dense["counter_keyed"] == 0
+    (sink,) = dense["sinks"]
+    assert sink["layout_row"] == "ping"
+    assert sink["warp_terms"] == ["probe_draw"]
+    sparse = r1["entries"]["toy.sparse"]
+    assert sparse["counter_keyed"] == 1 and sparse["chain_coupled"] == 0
+    assert r1["totals"]["chain_coupled_draw_bytes"] == 16  # f32[4] ping draw
+
+
+def test_leap_findings_missing_and_stale(tmp_path):
+    graphs = _toy_graphs()
+    path = tmp_path / "LEAP.json"
+    missing = rng_scan.leap_findings(graphs, path)
+    assert [f.symbol for f in missing] == ["missing"]
+
+    rng_scan.write_leap_report(rng_scan.build_leap_report(graphs), path)
+    assert rng_scan.leap_findings(graphs, path) == []
+
+    del graphs["toy.sparse"]
+    stale = rng_scan.leap_findings(graphs, path)
+    assert [f.symbol for f in stale] == ["stale"]
+    assert all(f.rule == "KB605" for f in stale)
+
+
+def test_render_leap_report_names_chain_sites():
+    text = rng_scan.render_leap_report(rng_scan.build_leap_report(_toy_graphs()))
+    assert "chain-coupled sites" in text
+    assert "row=ping" in text
+    assert "probe_draw" in text
+
+
+def test_committed_leap_report_schema():
+    committed = rng_scan.load_leap_report(REPO / "KEYSCOPE_LEAP.json")
+    assert committed is not None
+    assert committed["streams"] == dict(rng_rules.KEYSCOPE_STREAMS)
+    # Every entry classifies every sink; the item-2 worklist is non-empty.
+    assert committed["totals"]["chain_coupled"] > 0
+    assert committed["totals"]["counter_keyed"] > 0
+    assert committed["totals"]["impure"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+
+
+def test_lane_flags_are_exclusive(capsys):
+    assert main(["--ir", "--rng"]) == 2
+    assert main(["--all", "--conc"]) == 2
+    assert main(["--all", "--write-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_rng_subcommand_spelling(capsys):
+    # `rng` as first arg == --rng, matching the `conc` subcommand.
+    rc = main(["rng", "--entries", "ops.crc32", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_explain_covers_every_lane(capsys):
+    for rid in ("KB101", "KB401", "KB501", "KB601", "KB605"):
+        assert main(["--explain", rid]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith(rid)
+
+
+# ---------------------------------------------------------------------------
+# seeded mutation (a): key_ping reused for the bern draw — in-process route
+
+
+def test_mutation_ping_reuse_red_inprocess(monkeypatch, capsys):
+    import kaboodle_tpu.phasegraph.exec as exec_mod
+
+    # Pristine first: the same scoped invocation is clean.
+    assert main(["--rng", "--entries", "phasegraph.tick.random",
+                 "--no-baseline"]) == 0
+    capsys.readouterr()
+
+    def reused(key):
+        ks = jax.random.split(key, 5)
+        return ks[0], ks[1], ks[1], ks[3], ks[4]  # bern <- ping
+
+    monkeypatch.setattr(exec_mod, "split_tick_keys", reused)
+    rc = main(["--rng", "--entries", "phasegraph.tick.random", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "KB601" in out
+
+
+# ---------------------------------------------------------------------------
+# seeded mutation (b): STREAM_* swap — in-process route
+
+
+def test_mutation_stream_swap_red_inprocess(monkeypatch, capsys):
+    import kaboodle_tpu.sparseplane.rng as sprng
+
+    ping, ack = sprng.STREAM_PING, sprng.STREAM_ACK
+    monkeypatch.setattr(sprng, "STREAM_PING", ack)
+    monkeypatch.setattr(sprng, "STREAM_ACK", ping)
+    # The swapped ids still trace collision-free (the set is unchanged) —
+    # only the registry comparison, which runs on ANY scoped scan, reds.
+    rc = main(["--rng", "--entries", "ops.crc32", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "KB602" in out and "renumber" in out
+
+
+# ---------------------------------------------------------------------------
+# seeded mutation (c): PRNGKey(0) bypassing the cursor — in-process route
+
+
+def test_mutation_const_key_red_inprocess(monkeypatch, capsys):
+    import kaboodle_tpu.sparseplane.rng as sprng
+
+    monkeypatch.setattr(
+        sprng, "stream_key", lambda seed, cursor, stream: jax.random.PRNGKey(0)
+    )
+    rc = main(["--rng", "--entries", "phasegraph.tick.sparse", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "KB603" in out
+
+
+# ---------------------------------------------------------------------------
+# the same three mutations through the subprocess route CI runs
+
+
+def _copy_package(tmp_path) -> pathlib.Path:
+    """Full kaboodle_tpu shadow copy that WINS the import path (unlike the
+    conc harness's bare tree: the rng lane traces imported code, so the
+    mutated modules must actually import)."""
+    dst = tmp_path / "kaboodle_tpu"
+    shutil.copytree(
+        REPO / "kaboodle_tpu", dst,
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    return dst
+
+
+def _run_rng_subprocess(tmp_path, *extra):
+    return subprocess.run(
+        [sys.executable, "-m", "kaboodle_tpu.analysis", "--rng",
+         "--no-baseline", *extra],
+        cwd=tmp_path, capture_output=True, text=True,
+        env={
+            **os.environ,
+            "PYTHONPATH": f"{tmp_path}{os.pathsep}{REPO}",
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+
+
+def _mutate(path: pathlib.Path, old: str, new: str) -> None:
+    src = path.read_text()
+    assert old in src, f"mutation anchor missing in {path.name}"
+    path.write_text(src.replace(old, new, 1))
+
+
+def test_mutation_ping_reuse_red_subprocess(tmp_path):
+    dst = _copy_package(tmp_path)
+    anchor = (
+        "key_proxy, key_ping, key_bern, key_drop, key_next = "
+        "split_tick_keys(st.key)"
+    )
+    _mutate(dst / "phasegraph" / "exec.py", anchor,
+            anchor + "\n        key_bern = key_ping")
+    proc = _run_rng_subprocess(
+        tmp_path, "--entries", "phasegraph.tick.random"
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KB601" in proc.stdout
+
+
+def test_mutation_stream_swap_red_subprocess(tmp_path):
+    dst = _copy_package(tmp_path)
+    rng_py = dst / "sparseplane" / "rng.py"
+    _mutate(rng_py, "STREAM_PING = 3", "STREAM_PING = 4")
+    _mutate(rng_py, "STREAM_ACK = 4", "STREAM_ACK = 3")
+    proc = _run_rng_subprocess(tmp_path, "--entries", "ops.crc32")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KB602" in proc.stdout
+
+
+def test_mutation_const_key_red_subprocess(tmp_path):
+    dst = _copy_package(tmp_path)
+    _mutate(
+        dst / "sparseplane" / "rng.py",
+        "    base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)\n"
+        "    return jax.random.fold_in(base, jnp.uint32(stream))",
+        "    return jax.random.PRNGKey(0)  # seeded KB603: cursor bypassed",
+    )
+    proc = _run_rng_subprocess(
+        tmp_path, "--entries", "phasegraph.tick.sparse"
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "KB603" in proc.stdout

@@ -2,7 +2,7 @@
 PYTHON ?= python
 PORT ?= 7475
 
-.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun scan-dryrun conc-dryrun rng-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun sparse-dryrun demo2 probe sim clean
+.PHONY: test lint native bench ci fleet-dryrun warp-dryrun warp2-dryrun warp3-dryrun scan-dryrun conc-dryrun rng-dryrun telemetry-dryrun phasegraph-dryrun serve-dryrun serve-chaos-dryrun serve-obs-dryrun costscope-dryrun fedserve-dryrun sparse-dryrun demo2 probe sim clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -66,6 +66,7 @@ ci: lint native test
 	$(MAKE) fleet-dryrun
 	$(MAKE) warp-dryrun
 	$(MAKE) warp2-dryrun
+	$(MAKE) warp3-dryrun
 	$(MAKE) telemetry-dryrun
 	$(MAKE) phasegraph-dryrun
 	$(MAKE) serve-dryrun
@@ -100,6 +101,25 @@ warp-dryrun:
 warp2-dryrun:
 	timeout 420 $(PYTHON) bench.py --warp --scenario churn-recovery \
 	  --platform cpu --n 128 --ticks 1536
+
+# Warp 3.0 dryrun (counter-keyed RNG + signature-keyed span memoization,
+# ISSUE 20) at toy scale: the churn-recovery lane runs the memo A/B
+# in-process — banking pass, then a timed all-hit replay pass that the
+# bench asserts is dispatch-free (every ledger row +memo), within the
+# SpanMemo byte/entry bounds, eviction-free, with hits > 0 and ZERO fresh
+# compiles (KB405 counter; bench exits 4 on a minted program and 3 on any
+# memo-on/off or dense/warp bit mismatch). The CLI step then drives the
+# two Warp 3.0 knobs end-to-end: an explicit distributional run with the
+# memo off (the one non-bit-exact tier, pinned by its own fuzz arm in
+# tests/test_warp_memo.py). The measured >= 5x acceptance run is the
+# full-size `python bench.py --warp --scenario churn-recovery --platform
+# cpu --out BENCH_warp3.json` (PERF.md "Warp 3.0"); CI only proves the
+# lane + its invariants.
+warp3-dryrun:
+	timeout 420 $(PYTHON) bench.py --warp --scenario churn-recovery \
+	  --platform cpu --n 96 --ticks 1024
+	timeout 300 env JAX_PLATFORMS=cpu $(PYTHON) -m kaboodle_tpu \
+	  --sim 64 --ticks 96 --warp --warp-mode distributional --no-warp-memo
 
 # Telemetry dryrun (kaboodle_tpu/telemetry) at toy scale: a dense run and a
 # warped run each write a JSONL manifest (counters + flight-recorder dump),

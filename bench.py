@@ -360,7 +360,15 @@ def _bench_warp_drain_window(n: int, k: int):
     from kaboodle_tpu.sim.state import idle_inputs, init_state
     from kaboodle_tpu.spec import WAITING_FOR_PING
     from kaboodle_tpu.warp.horizon import decode_signature, make_signature_fn
-    from kaboodle_tpu.warp.runner import _get_leap, _span_chunks
+    from kaboodle_tpu.warp.runner import (
+        SpanMemo,
+        _digest_leaves,
+        _get_leap,
+        _host_leaves,
+        _memo_replay,
+        _memo_store,
+        _span_chunks,
+    )
 
     cfg = SwimConfig(ping_timeout_ticks=4 * k)
     lean = n >= LEAN_STATE_MIN_N
@@ -416,9 +424,30 @@ def _bench_warp_drain_window(n: int, k: int):
     jax.block_until_ready(out_w)
     warp_wall = max(time.perf_counter() - t0 - rtt, 1e-9)
 
+    # Memo leg (Warp 3.0): bank the window's delta once, then time a FULL
+    # replay — host leaf fetch + entry digest + LRU lookup + XOR apply —
+    # exactly the work a recurring serve-lane window costs with the memo
+    # on. Timed with the same honest accounting as the dispatch legs.
+    memo = SpanMemo()
+    entry = _host_leaves(st)
+    _memo_store(memo, ("window", cls.key, k, _digest_leaves(entry)), entry,
+                out_w)
+    t0 = time.perf_counter()
+    entry2 = _host_leaves(st)
+    deltas = memo.get(("window", cls.key, k, _digest_leaves(entry2)),
+                      kind="window")
+    assert deltas is not None
+    out_m = _memo_replay(st, entry2, deltas[0])
+    jax.block_until_ready(out_m)
+    memo_wall = max(time.perf_counter() - t0 - rtt, 1e-9)
+
     bit_exact = all(
         _leaf_equal(a, b)
         for a, b in zip(jax.tree.leaves(out_d), jax.tree.leaves(out_w))
+    )
+    memo_bit_exact = all(
+        _leaf_equal(a, b)
+        for a, b in zip(jax.tree.leaves(out_d), jax.tree.leaves(out_m))
     )
     return {
         "window_n": n,
@@ -427,13 +456,17 @@ def _bench_warp_drain_window(n: int, k: int):
         "window_dense_wall_s": round(dense_wall, 4),
         "window_warp_wall_s": round(warp_wall, 4),
         "window_speedup": round(dense_wall / warp_wall, 2),
+        "window_memo_wall_s": round(memo_wall, 4),
+        "window_memo_speedup": round(dense_wall / memo_wall, 2),
         "window_bit_exact": bit_exact,
+        "window_memo_bit_exact": memo_bit_exact,
     }
 
 
 def _bench_warp_churn_recovery(n: int, ticks: int):
-    """Warp 2.0 A/B: signature-classed fast-forward on the churn-recovery
-    drain (ISSUE 8 acceptance: >= 10x over dense on the calm phase).
+    """Warp 2.0/3.0 A/B: signature-classed fast-forward on the churn-recovery
+    drain (ISSUE 8 acceptance: >= 10x over dense on the calm phase;
+    ISSUE 20 acceptance: memo-on e2e >= 5x over dense at N >= 1,024).
 
     Config-3-shaped schedule: staggered kills (plus one revive) through the
     first half, calm drain through the second — the regime where Warp 1.x
@@ -448,16 +481,29 @@ def _bench_warp_churn_recovery(n: int, ticks: int):
     bit-for-bit before any number is reported. The compiled-program cache
     bound is asserted from the inside (ProgramCache stats) on top of the
     KB405 gate.
+
+    Warp 3.0 adds the memo A/B on top: a banking pass fills a run-sized
+    :class:`SpanMemo` (every span's state delta, leaped AND dense), then a
+    timed replay pass re-runs the same calm drain entirely from the cache
+    — zero span dispatches (every ledger row ``+memo``), zero fresh
+    compiles (asserted via the KB405 counter), bit-identical final state
+    (asserted vs the dense arm). The memo-on wall is what the
+    counter-keyed RNG bought: with every draw a pure function of
+    (state, tick, stream), a recurring span IS its banked delta, so the
+    dense seasons the why-dense histogram attributes to the drain
+    collapse to host XOR replays.
     """
     import jax
     import jax.numpy as jnp
 
+    from kaboodle_tpu.analysis.ir.surface import compile_counter
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.sim.runner import simulate
     from kaboodle_tpu.sim.scenario import Scenario
     from kaboodle_tpu.sim.state import init_state
     from kaboodle_tpu.warp.runner import (
         CHUNK_BUCKETS,
+        SpanMemo,
         WarpLedger,
         leap_cache,
         simulate_warped,
@@ -522,9 +568,48 @@ def _bench_warp_churn_recovery(n: int, ticks: int):
     jax.block_until_ready(out_w)
     warp_wall = max(time.perf_counter() - t0 - rtt, 1e-9)
 
+    # Memo arms (Warp 3.0). The memo is sized for the run: every span's
+    # delta is full-state-sized, so the default 256 MiB cap would evict
+    # the head of a long drain before the replay pass reaches it — the
+    # bench wants the all-hit regime (the serve-lane steady state), and
+    # reports the actual resident bytes so the sizing is auditable.
+    memo = SpanMemo(max_bytes=16 << 30, max_entries=65536)
+    out_b, _, _ = simulate_warped(
+        st_c, calm_inputs, cfg, faulty=True, memo=memo
+    )  # banking pass: dispatches once, banks every span delta
+    jax.block_until_ready(out_b)
+    memo_ledger = WarpLedger()
+    with compile_counter() as box:
+        t0 = time.perf_counter()
+        out_m, dense_ticks_m, _ = simulate_warped(
+            st_c, calm_inputs, cfg, faulty=True, ledger=memo_ledger,
+            memo=memo,
+        )
+        jax.block_until_ready(out_m)
+        memo_wall = max(time.perf_counter() - t0 - rtt, 1e-9)
+    memo_stats = memo.stats()
+    # The replay pass must be all-hit and dispatch-free: every ledger row
+    # a +memo replay, zero fresh compiles — the invariants the warp3
+    # dryrun gates in CI.
+    assert all(r["engine"].endswith("+memo") for r in memo_ledger.spans), (
+        memo_ledger.blocked_histogram()
+    )
+    assert all(r["dispatches"] == 0 for r in memo_ledger.spans)
+    assert memo_stats["hits"] > 0 and memo_stats["evictions"] == 0, memo_stats
+    assert memo_stats["bytes"] <= memo.max_bytes
+    assert memo_stats["entries"] <= memo.max_entries
+    compiles_steady = box.count
+
     bit_exact = all(
         _leaf_equal(a, b)
         for a, b in zip(jax.tree.leaves(out_d), jax.tree.leaves(out_w))
+    )
+    memo_bit_exact = (
+        all(
+            _leaf_equal(a, b)
+            for a, b in zip(jax.tree.leaves(out_d), jax.tree.leaves(out_m))
+        )
+        and list(dense_ticks) == list(dense_ticks_m)
     )
     obs_bit_exact = all(
         _leaf_equal(a, b)
@@ -547,6 +632,8 @@ def _bench_warp_churn_recovery(n: int, ticks: int):
         "dense_wall_s": round(dense_wall, 4),
         "warp_wall_s": round(warp_wall, 4),
         "speedup": round(dense_wall / warp_wall, 2),
+        "memo_wall_s": round(memo_wall, 4),
+        "memo_speedup": round(dense_wall / memo_wall, 2),
         "dense_ticks_executed": int(dense_ticks.size),
         "leaped_ticks": int(ticks - churn_end - dense_ticks.size),
         "hybrid_leaped_ticks": int(hybrid_ticks),
@@ -554,7 +641,15 @@ def _bench_warp_churn_recovery(n: int, ticks: int):
         "signature_classes": len(per_class),
         "leap_cache": cache,
         "why_dense": ledger.blocked_histogram(),
+        "why_dense_memo": memo_ledger.blocked_histogram(),
+        "memo": {
+            k: memo_stats[k]
+            for k in ("entries", "bytes", "hits", "misses", "evictions",
+                      "hit_rate", "per_kind")
+        },
+        "compiles_steady": compiles_steady,
         "bit_exact": bit_exact,
+        "memo_bit_exact": memo_bit_exact,
         "obs_bit_exact": obs_bit_exact,
         "state_variant": ("lean+int16" if narrow else "lean") if lean else "full",
     }
@@ -1184,6 +1279,11 @@ def main() -> None:
                         "tick cost + convergence curves + the zero-recompile "
                         "pin + sub-quadratic bytes evidence) instead of the "
                         "standard sections; writes BENCH_sparse.json")
+    p.add_argument("--out", metavar="PATH", default=None,
+                   help="(--warp --scenario churn-recovery) also write the "
+                        "full JSON line to PATH — the acceptance run banks "
+                        "BENCH_warp3.json this way; dryruns omit it so toy "
+                        "numbers never overwrite the banked capture")
     p.add_argument("--manifest", metavar="PATH", default=None,
                    help="append the BENCHDOC line as a 'run' record to a "
                         "JSONL telemetry manifest (kaboodle_tpu.telemetry."
@@ -1236,33 +1336,44 @@ def main() -> None:
         # driver's tail capture always parses.
         wn = args.n or (4096 if not on_tpu else 16384)
         if args.scenario == "churn-recovery":
-            # Two measurements (PERF.md "Warp 2.0"): the end-to-end
-            # orchestrated A/B at a wall-clock-feasible N (discovery and
-            # expiry seasons are ~N/2 ticks wide, so the full drain at
-            # N=4,096 would scan for hours on the CPU lane), and the
-            # claim-bearing calm-WINDOW ratio at representative N — dense
-            # vs the hybrid leap over one mid-drain waiting window, the
-            # state shape the calm phase is made of.
-            wn = args.n or (384 if not on_tpu else 8192)
-            wt = 16384 if args.ticks is None else args.ticks
+            # Two measurements (PERF.md "Warp 2.0" / "Warp 3.0"): the
+            # end-to-end orchestrated A/B at a wall-clock-feasible N
+            # (discovery and expiry seasons are ~N/2 ticks wide, so the
+            # full drain at N=4,096 would scan for hours on the CPU
+            # lane), memo off AND on, and the claim-bearing calm-WINDOW
+            # ratio at representative N — dense vs the hybrid leap vs the
+            # memo replay over one mid-drain waiting window, the state
+            # shape the calm phase is made of. The Warp 3.0 acceptance
+            # run is N=1,024 e2e + N=4,096 window (BENCH_warp3.json).
+            wn = args.n or (1024 if not on_tpu else 8192)
+            if args.ticks is not None:
+                wt = args.ticks
+            else:
+                # Season widths scale with N (~N/2 discovery) but the
+                # dense arm's wall scales with N^2 * ticks: at N >= 1,024
+                # halve the schedule so the CPU-lane dense arm stays in
+                # minutes (the drain still fits: timeout = calm/3).
+                wt = 8192 if wn >= 1024 else 16384
             warp = _bench_warp_churn_recovery(wn, wt)
             wn2 = args.n or (4096 if not on_tpu else 16384)
             window = _bench_warp_drain_window(wn2, 256 if wn2 >= 1024 else 64)
             line = {
-                "metric": "warp2_churn_recovery_calm_window_speedup_vs_dense",
-                "value": window["window_speedup"],
+                "metric": "warp3_churn_recovery_memo_e2e_speedup_vs_dense",
+                "value": warp["memo_speedup"],
                 "unit": "x",
                 "n_peers": warp["n"],
                 "ticks": warp["ticks"],
                 "backend": backend + (" (fallback: accelerator unresponsive)"
                                       if fallback else ""),
                 "e2e_speedup": warp["speedup"],
+                "memo_e2e_speedup": warp["memo_speedup"],
                 **{k: warp[k] for k in (
                     "calm_ticks", "ping_timeout_ticks", "dense_wall_s",
-                    "warp_wall_s", "dense_ticks_executed", "leaped_ticks",
-                    "hybrid_leaped_ticks", "strict_leaped_ticks",
-                    "signature_classes", "leap_cache", "bit_exact",
-                    "state_variant")},
+                    "warp_wall_s", "memo_wall_s", "dense_ticks_executed",
+                    "leaped_ticks", "hybrid_leaped_ticks",
+                    "strict_leaped_ticks", "signature_classes", "leap_cache",
+                    "why_dense", "why_dense_memo", "memo", "compiles_steady",
+                    "bit_exact", "memo_bit_exact", "state_variant")},
                 **window,
                 "peak_rss_mib": round(
                     resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
@@ -1270,8 +1381,16 @@ def main() -> None:
             }
             _emit_benchdoc(line, manifest=args.manifest)
             print(json.dumps(line))
-            if not (warp["bit_exact"] and window["window_bit_exact"]):
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(line, f, indent=4)
+                    f.write("\n")
+            if not (warp["bit_exact"] and warp["memo_bit_exact"]
+                    and window["window_bit_exact"]
+                    and window["window_memo_bit_exact"]):
                 sys.exit(3)  # a speedup from a wrong state is worthless
+            if warp["compiles_steady"] != 0:
+                sys.exit(4)  # the memo replay minted a program
             return
         wt = 256 if args.ticks is None else args.ticks  # acceptance shape default
         warp = _bench_warp(wn, wt)

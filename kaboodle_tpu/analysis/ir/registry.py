@@ -133,7 +133,13 @@ def _tick_lean():
 
 def _tick_random():
     # deterministic=False exercises the real sampling draws (gumbel /
-    # bernoulli / uniform) — where dtype-less defaults hide.
+    # bernoulli / uniform) — where dtype-less defaults hide. Since Warp
+    # 3.0 this entry IS the counter-keyed variant: every draw derives a
+    # per-(row, tick, stream) key via phasegraph.rng.tick_draw_keys, so
+    # keyscope's provenance walk over this trace (and tick.sparse's, the
+    # (seed, cursor, stream) twin) is what banks the counter_keyed sinks
+    # in KEYSCOPE_LEAP.json — no extra registry entry needed, the
+    # migration changed the programs these entries already trace.
     from kaboodle_tpu.config import SwimConfig
     from kaboodle_tpu.phasegraph.derive import make_dense_tick
 

@@ -385,6 +385,36 @@ class _Walker:
             )
             return
 
+        if name == "select_n":
+            # Batched ``lax.cond`` (vmapped engines: the fleet/serve tick)
+            # lowers key selection to ``select_n`` over the unwrapped u32
+            # bytes. Operand 0 is the predicate; merge the data operands so
+            # the counter chains survive vmap instead of re-rooting as a
+            # fresh carried_key at the next wrap.
+            cands = [self._read(env, v) for v in eqn.invars[1:]]
+            resolved, raw = [], False
+            for c in cands:
+                if isinstance(c, Node):
+                    resolved.append(c)
+                elif isinstance(c, _Raw):
+                    resolved.append(c.node)
+                    raw = True
+                elif isinstance(c, _RowRaw):
+                    resolved.append(self._row_node(c.split, c.row))
+                    raw = True
+            if resolved:
+                first = resolved[0]
+                if all(r is first for r in resolved) and len(resolved) == len(cands):
+                    out = first
+                else:
+                    out = Node(
+                        "merge", tuple(resolved),
+                        frozenset().union(*(r.taint for r in resolved)),
+                        looped=all(r.looped for r in resolved),
+                    )
+                env["nodes"][eqn.outvars[0]] = _Raw(out) if raw else out
+            return
+
         # Raw-byte plumbing: keep the u32 shadow alive through moves.
         if name == "slice":
             tracked = self._read(env, eqn.invars[0])

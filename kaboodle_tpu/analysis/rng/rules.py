@@ -4,7 +4,7 @@ Per-graph rules follow the graftscan pass shape — ``check(graph) ->
 [Finding]`` with ``ir://<entry>`` pseudo-paths and line-free symbols (one
 justified baseline entry covers a finding class and survives unrelated
 edits). KB602 additionally has a trace-free registry half (the pinned
-``KEYSCOPE_STREAMS`` table vs the live ``sparseplane.rng`` constants —
+``KEYSCOPE_STREAMS`` table vs the live ``phasegraph.rng`` constants —
 double-entry bookkeeping, so a renumbering or swap trips the lane even
 when the swapped streams still trace to a collision-free set), and KB604
 is a cross-entry rule over the whole scanned set.
@@ -17,11 +17,15 @@ from kaboodle_tpu.analysis.rng.provenance import ProvenanceGraph, Sink
 
 # -- the pinned stream table (KB602's second ledger) ------------------------
 
-# keyscope's own copy of sparseplane/rng.py's STREAM_* registry. The two
+# keyscope's own copy of phasegraph/rng.py's STREAM_* registry (the
+# canonical counter-RNG module; sparseplane/rng.py re-exports it). The two
 # are compared verbatim on every rng run: ids must be dense from 0, in
-# append-only order, and value-identical. A new sparse phase appends to
-# BOTH (this tuple and rng.py) — that dual edit is the mechanical form of
-# rng.py's "new phases append, renumbering changes every banked run".
+# append-only order, and value-identical. A new randomized phase appends
+# to BOTH (this tuple and rng.py) — that dual edit is the mechanical form
+# of rng.py's "new phases append, renumbering changes every banked run".
+# Ids 0-5 are the sparse tick's (seed, cursor) streams; 6-9 are the dense
+# tick's (key, tick) streams (the Warp 3.0 counter-keyed migration of the
+# legacy KEY_LAYOUT split rows).
 KEYSCOPE_STREAMS = (
     ("STREAM_PROXY", 0),
     ("STREAM_CHAIN", 1),
@@ -29,9 +33,19 @@ KEYSCOPE_STREAMS = (
     ("STREAM_PING", 3),
     ("STREAM_ACK", 4),
     ("STREAM_GOSSIP", 5),
+    ("STREAM_TICK_PROXY", 6),
+    ("STREAM_TICK_PING", 7),
+    ("STREAM_TICK_BERN", 8),
+    ("STREAM_TICK_DROP", 9),
 )
 
 _STREAMS_PATH = "rng://sparseplane.streams"
+
+# Dense-tick stream id -> the legacy KEY_LAYOUT row name the stream
+# replaced. The leap report uses this to keep naming the migrated
+# (now counter-keyed) sinks by their warp rows, so WARP_TERMS joins
+# survive the re-keying.
+TICK_STREAM_ROWS = {6: "proxy", 7: "ping", 8: "bern", 9: "drop"}
 
 # -- KB604: declared cross-engine fates -------------------------------------
 
@@ -259,7 +273,7 @@ def check_kb602_stream_collision(graph: ProvenanceGraph) -> list[Finding]:
             f.src.line if f.src else 0,
             f"counter-chain fold_in constant {f.const} "
             f"({f.src.render() if f.src else '<unknown>'}) is not a "
-            "registered STREAM_* id — append it to sparseplane/rng.py AND "
+            "registered STREAM_* id — append it to phasegraph/rng.py AND "
             "keyscope's KEYSCOPE_STREAMS table",
             symbol,
         )
@@ -267,13 +281,13 @@ def check_kb602_stream_collision(graph: ProvenanceGraph) -> list[Finding]:
 
 
 def check_kb602_stream_registry() -> list[Finding]:
-    """The pinned table vs the live sparseplane constants (trace-free).
+    """The pinned table vs the live phasegraph.rng constants (trace-free).
 
     Ids must be dense from 0 in append-only order and value-identical to
     ``KEYSCOPE_STREAMS`` — a swap keeps the traced fold constants
     collision-free and set-equal, so only this double-entry comparison
     catches it before a banked run diverges."""
-    from kaboodle_tpu.sparseplane.rng import stream_table
+    from kaboodle_tpu.phasegraph.rng import stream_table
 
     out: list[Finding] = []
     live = stream_table()

@@ -67,15 +67,31 @@ def run_rng_scan(
 
 
 def _layout_name(sink) -> str | None:
-    """KEY_LAYOUT row name of a dense-chain sink (None off the chain)."""
+    """Draw-row name of a sink (None when it has no row identity).
+
+    Dense-chain sinks are named by their split-row coordinate
+    (``KEY_LAYOUT[row]``); counter-keyed tick draws (Warp 3.0) by the
+    ``STREAM_TICK_*`` fold constant nearest the sink — the same four row
+    names, so per-row warp_terms attribution survives the migration off
+    the split chain. Sparse (seed, cursor, stream) sinks keep a null row:
+    their streams are per-op lanes, not tick-split rows."""
     from kaboodle_tpu.phasegraph.ops import KEY_LAYOUT
 
-    if "carried_key" not in sink.node.roots():
-        return None
-    row = sink.node.layout_row()
-    if row is None or row >= len(KEY_LAYOUT):
-        return None
-    return KEY_LAYOUT[row]
+    roots = sink.node.roots()
+    if "carried_key" in roots:
+        row = sink.node.layout_row()
+        if row is None or row >= len(KEY_LAYOUT):
+            return None
+        return KEY_LAYOUT[row]
+    if "counter_seed" in roots:
+        n, seen = sink.node, set()
+        while n is not None and id(n) not in seen:
+            seen.add(id(n))
+            if n.kind == "fold" and isinstance(n.const, int):
+                if n.const in rules.TICK_STREAM_ROWS:
+                    return rules.TICK_STREAM_ROWS[n.const]
+            n = n.parents[0] if n.parents else None
+    return None
 
 
 def build_leap_report(
@@ -187,15 +203,37 @@ def leap_findings(
             )
         ]
     live = build_leap_report(graphs, costscope_path=costscope_path)
+    findings: list[Finding] = []
+    # Warp 3.0 shrink gate: the chain-coupled total is a ratchet. New
+    # split-chain draw sites regress the counter-key migration (they
+    # re-create exactly the dense seasons item 2 retired), so growth is a
+    # hard finding even on an otherwise regenerated report — fixed by
+    # deriving the new draw from phasegraph.rng counter keys, never by
+    # committing the bigger number.
+    c_chain = int(committed.get("totals", {}).get(rules.CLASS_CHAIN, 0))
+    l_chain = int(live["totals"][rules.CLASS_CHAIN])
+    if l_chain > c_chain:
+        findings.append(
+            Finding(
+                _LEAP_PATH,
+                "KB605",
+                0,
+                f"chain-coupled sink total grew {c_chain} -> {l_chain} — "
+                "new draw sites fork the carried key chain; re-key them "
+                "through phasegraph.rng counter streams instead of "
+                "re-banking the report",
+                "growth",
+            )
+        )
     if committed == live:
-        return []
+        return findings
     stale = []
     c_entries, l_entries = committed.get("entries", {}), live["entries"]
     for name in sorted(set(c_entries) | set(l_entries)):
         if c_entries.get(name) != l_entries.get(name):
             stale.append(name)
     detail = f"entries differ: {stale[:6]}" if stale else "header/totals differ"
-    return [
+    findings.append(
         Finding(
             _LEAP_PATH,
             "KB605",
@@ -204,7 +242,8 @@ def leap_findings(
             "moved under it; regenerate with --write-leap and commit",
             "stale",
         )
-    ]
+    )
+    return findings
 
 
 def render_leap_report(report: dict) -> str:

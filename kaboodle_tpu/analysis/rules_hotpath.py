@@ -110,11 +110,14 @@ DTYPE_DISCIPLINE_FILES = (
     # (engine.py the FILENAME is already listed for oracle/; names match
     # within HOT_DIRS, so serve/engine.py is covered by that entry.)
     "pool.py",
-    # sparseplane/: kernel.py/state.py ride the entries above (names match
-    # within HOT_DIRS); repair.py's rank-match scatter and rng.py's
-    # counter-draw chain carry the same discipline — int32 neighbor
-    # indices with -1 sentinels, int16/int32 block timers, uint32
-    # (seed, cursor) whose wraparound the checkpoint resume depends on.
+    # sparseplane/ + phasegraph/: kernel.py/state.py ride the entries
+    # above (names match within HOT_DIRS); repair.py's rank-match scatter
+    # and rng.py's counter-draw chains carry the same discipline — int32
+    # neighbor indices with -1 sentinels, int16/int32 block timers, uint32
+    # (seed, cursor) / (key, tick, stream) folds whose wraparound both the
+    # checkpoint resume and the Warp 3.0 counter keys depend on. "rng.py"
+    # covers BOTH the canonical kaboodle_tpu/phasegraph/rng.py and the
+    # sparseplane shim re-exporting it.
     "repair.py", "rng.py",
     # costscope: the microbench payloads. uint32 fingerprints into pmin/
     # pmax agreement, uint32 all-ones partials into psum_scatter — a

@@ -68,6 +68,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "fast-forward only strictly quiescent spans — the "
                         "Warp 1.x behavior knob; the default leaps armed-"
                         "timer drain windows too (bit-exact either way)")
+    p.add_argument("--no-warp-memo", action="store_true",
+                   help="with --warp: disable the Warp 3.0 signature-keyed "
+                        "span memo — every span (leaped and dense) then "
+                        "dispatches instead of replaying banked state "
+                        "deltas; results are bit-identical either way")
+    p.add_argument("--warp-mode", choices=["exact", "distributional"],
+                   default="exact",
+                   help="with --warp: 'exact' (default) is bit-exact with "
+                        "dense ticking; 'distributional' also leaps drain "
+                        "seasons whose per-tick draws only shift event "
+                        "ARRIVAL ticks (suspicion countdowns, probe "
+                        "waits) — distribution-pinned, not bit-pinned")
     p.add_argument("--telemetry", nargs="?", const="telemetry.jsonl",
                    default=None, metavar="PATH",
                    help="sim mode: run the telemetry-plane kernel build "
@@ -306,22 +318,29 @@ def run_sim(args) -> int:
         # --telemetry the leaped spans still contribute counter totals via
         # the closed form (telemetry.counters.leap_counters).
         from kaboodle_tpu.sim.runner import state_converged
-        from kaboodle_tpu.warp.runner import WarpLedger, simulate_warped
+        from kaboodle_tpu.warp.runner import (
+            WarpLedger,
+            simulate_warped,
+            span_memo,
+        )
 
         hybrid = not args.no_warp_hybrid
+        memo = None if args.no_warp_memo else span_memo
         ledger = WarpLedger()
         t0 = time.perf_counter()
         if telemetry:
             final, dense_ticks, stacked, totals = simulate_warped(
                 state, sc.build(), SwimConfig(), faulty=True, telemetry=True,
-                hybrid=hybrid, ledger=ledger,
+                hybrid=hybrid, ledger=ledger, memo=memo,
+                warp_mode=args.warp_mode,
             )
             m = stacked.metrics if stacked is not None else None
             counters = stacked.counters if stacked is not None else None
         else:
             final, dense_ticks, m = simulate_warped(
                 state, sc.build(), SwimConfig(), faulty=True,
-                hybrid=hybrid, ledger=ledger,
+                hybrid=hybrid, ledger=ledger, memo=memo,
+                warp_mode=args.warp_mode,
             )
             counters = totals = None
         final_conv = bool(state_converged(final))
@@ -332,6 +351,8 @@ def run_sim(args) -> int:
             "ticks": sc.ticks,
             "warp": True,
             "warp_hybrid": hybrid,
+            "warp_mode": args.warp_mode,
+            "warp_memo": memo is not None,
             "dense_ticks_executed": int(dense_ticks.size),
             "leaped_ticks": int(sc.ticks - dense_ticks.size),
             "leap_classes": {
@@ -340,6 +361,14 @@ def run_sim(args) -> int:
             "final_converged": final_conv,
             "wall_s": round(wall, 3),
         }
+        if memo is not None:
+            ms = memo.stats()
+            out["warp_memo_stats"] = {
+                "hits": ms["hits"], "misses": ms["misses"],
+                "entries": ms["entries"], "bytes": ms["bytes"],
+                "evictions": ms["evictions"],
+                "hit_rate": round(ms["hit_rate"], 4),
+            }
         if totals is not None:
             out["counter_totals"] = totals
         _write_sim_manifests(args, out, m, counters, ticks=dense_ticks,

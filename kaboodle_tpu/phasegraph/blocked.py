@@ -69,7 +69,7 @@ import jax.numpy as jnp
 from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.hashing import fingerprint_agreement, peer_record_hash
 from kaboodle_tpu.phasegraph.graph import build_graph
-from kaboodle_tpu.phasegraph.ops import split_tick_keys
+from kaboodle_tpu.phasegraph import rng as pg_rng
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.ops.sampling import (
     _stable_k_smallest_iter,
@@ -172,7 +172,7 @@ def make_chunked_tick_fn(
     graph = build_graph(cfg, faulty=faulty, telemetry=telemetry)
     prog = plan(graph, "blocked")
     _known = {
-        "rng_split", "churn", "delivery_gate", "row_stats", "join_gate",
+        "rng_streams", "churn", "delivery_gate", "row_stats", "join_gate",
         "manual_targets", "suspicion", "probe_draw", "join_insert",
         "failed_delivery", "join_replies", "call1", "call2", "calls34",
         "anti_entropy", "counters", "finish",
@@ -194,7 +194,11 @@ def make_chunked_tick_fn(
 
         t = st.tick
         idx = jnp.arange(n, dtype=jnp.int32)
-        key_proxy, key_ping, key_bern, key_drop, key_next = split_tick_keys(st.key)
+        # Counter-keyed draw rows (Warp 3.0, same derivation as exec.py);
+        # block-scoped draws fold the block index on top, so a [block, n]
+        # draw is keyed (key, tick, stream, block, row, col) — never chained.
+        key_proxy, key_ping, key_bern, key_drop = pg_rng.tick_draw_keys(st.key, t)
+        key_next = st.key
 
         S, T = st.state, st.timer
         tT = t.astype(T.dtype)

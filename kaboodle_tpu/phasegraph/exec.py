@@ -106,7 +106,7 @@ from kaboodle_tpu.ops.sampling import (
     choose_one_of_oldest_k,
 )
 from kaboodle_tpu.phasegraph.graph import build_graph
-from kaboodle_tpu.phasegraph.ops import split_tick_keys
+from kaboodle_tpu.phasegraph import rng as pg_rng
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.sim.state import MeshState, TickInputs, TickMetrics
 from kaboodle_tpu.spec import KNOWN, WAITING_FOR_INDIRECT_PING, WAITING_FOR_PING
@@ -166,7 +166,7 @@ def _gather_edge(mat: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
 # graph op without a body here (or a planner regrouping the fold illegally)
 # is a loud build error, never a silent semantic drift.
 _PROLOGUE_OPS = frozenset(
-    ("rng_split", "churn", "delivery_gate", "row_stats", "join_gate",
+    ("rng_streams", "churn", "delivery_gate", "row_stats", "join_gate",
      "manual_targets")
 )
 _FULL_TAIL_OPS = frozenset(
@@ -290,7 +290,12 @@ def make_tick_fn(
         t = st.tick
         idx = jnp.arange(n, dtype=jnp.int32)
         eye = idx[:, None] == idx[None, :]
-        key_proxy, key_ping, key_bern, key_drop, key_next = split_tick_keys(st.key)
+        # Counter-keyed draw rows (Warp 3.0): each key is a pure function of
+        # (st.key, t, STREAM_TICK_*) — no chain advances, so the key plane
+        # is constant and any tick's randomness replays from checkpointable
+        # state alone (rng.py; keyscope classes these counter_keyed).
+        key_proxy, key_ping, key_bern, key_drop = pg_rng.tick_draw_keys(st.key, t)
+        key_next = st.key
 
         S, T = st.state, st.timer
         # Timer writes must stay in the timer's dtype (int32 default, int16

@@ -89,23 +89,28 @@ BLOCKED_PLANES = {
 # PhaseOp.sparse vocabulary: the op's fate in a blocked_topk build.
 SPARSE_FATES = ("row", "block", "absent")
 
-# The dense 5-way key layout: what each row of the per-tick
-# ``split(key, 5)`` feeds (the ``rng_split`` op below). Every dense
-# engine — exec.py's kernel, blocked.py's chunked twin, span.py's leap
-# key chain — consumes the SAME rows in the SAME order, or their
-# bit-exactness diff breaks; this tuple is the single authority, and
-# keyscope (analysis/rng/) names draw sinks by these rows. Reordering or
-# appending here is a provenance-visible event, never a silent desync.
+# The LEGACY dense 5-way key layout: what each row of the per-tick
+# ``split(key, 5)`` fed before Warp 3.0 re-keyed the dense draws onto
+# per-(key, tick, stream) counter keys (phasegraph/rng.py — the
+# ``rng_streams`` op below). The row names survive as the draw-site
+# vocabulary: keyscope (analysis/rng/) names sinks by these rows (via
+# the split index of any remaining chain-coupled sink, or the
+# STREAM_TICK_* id of a migrated one), and the warp ledger's why-dense
+# terms join through them. Reordering or appending here is a
+# provenance-visible event, never a silent desync.
 KEY_LAYOUT = ("proxy", "ping", "bern", "drop", "next")
 KEY_PROXY, KEY_PING, KEY_BERN, KEY_DROP, KEY_NEXT = range(len(KEY_LAYOUT))
 
 
 def split_tick_keys(key):
-    """One tick's key fork: ``split(key, 5)`` rows in KEY_LAYOUT order.
+    """LEGACY one-tick key fork: ``split(key, 5)`` rows in KEY_LAYOUT order.
 
-    Returns ``(key_proxy, key_ping, key_bern, key_drop, key_next)``; the
-    carried key is the ``next`` row whatever happens this tick. jax is
-    imported locally — this module stays importable as pure metadata."""
+    Returns ``(key_proxy, key_ping, key_bern, key_drop, key_next)``. No
+    engine consumes this chain anymore (exec/blocked/span derive counter
+    keys via ``rng.tick_draw_keys``); it remains the executable definition
+    of the pre-Warp-3.0 scheme that keyscope's chain-sink naming and the
+    migration notes reference. jax is imported locally — this module stays
+    importable as pure metadata."""
     import jax
 
     return tuple(jax.random.split(key, len(KEY_LAYOUT)))
@@ -229,10 +234,13 @@ def op_table(
             )
     ops: list[PhaseOp] = [
         _op(
-            "rng_split", "-",
-            "Counter-based PRNG: split(key, 5) -> (proxy, ping, bern, drop, "
-            "next); the carried key is row 4 whatever happens this tick.",
-            "prologue", reads=("key",), writes=("key",), gives=("keys",),
+            "rng_streams", "-",
+            "Counter-keyed PRNG rows: fold (key, tick, STREAM_TICK_*) -> "
+            "(proxy, ping, bern, drop) draw keys (phasegraph/rng.py); the "
+            "carried key plane is constant — every draw is a pure function "
+            "of checkpointable state, so spans leap without consuming a "
+            "chain.",
+            "prologue", reads=("key", "tick"), gives=("keys",),
             span="live", sparse="row",
         ),
     ]

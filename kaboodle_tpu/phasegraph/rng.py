@@ -1,0 +1,119 @@
+"""Counter-based threefry streams shared by every derived engine.
+
+Warp 3.0 generalizes the sparseplane ``(seed, cursor, stream)`` discipline
+into the phasegraph IR itself.  Two key shapes coexist:
+
+- **Sparse counter pair** (``SparseState.seed``/``cursor``): the blocked
+  engine derives every draw from ``stream_key(seed, cursor, stream)`` —
+  unchanged, now hosted here (``sparseplane/rng.py`` re-exports).
+
+- **Dense carried key** (``MeshState.key``, a raw ``uint32[2]``): the
+  legacy scheme *split* the key five ways each tick and threaded the
+  remainder forward (``KEY_LAYOUT`` in phasegraph/ops.py), chaining every
+  draw to every prior tick's draws — the chain-coupled class that kept
+  drain seasons dense (KEYSCOPE_LEAP.json, ROADMAP item 2).  The counter
+  scheme instead derives each tick's draw keys as a pure function of
+  ``(key, tick, stream)``:
+
+      tick_key(stream) = fold_in(fold_in(PRNGKey(key[0] ^ key[1]), tick),
+                                 stream)
+
+  so the carried key plane is a *constant* (``key_next = key``) and any
+  tick's randomness is recomputable from checkpointable state alone — a
+  span of ticks can leap, replay, or memoize without consuming a chain.
+  Shaped draws supply the remaining counter words by element position, so
+  a ``(N, N)`` uniform is effectively keyed ``(seed, tick, stream, row,
+  col)`` — per-row keying without materializing per-row key arrays.
+
+Distinct ``STREAM_*`` ids keep per-phase draws independent (no key reuse
+across phases — the discipline KB601/KB204 enforce).  Every literal
+stream id folded onto a counter chain must appear in keyscope's
+``KEYSCOPE_STREAMS`` double-entry register (analysis/rng/rules.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# One id per randomized phase, in tick order.  New phases append —
+# renumbering changes every draw of every banked run.  Ids 0-5 are the
+# sparse tick's streams (folded onto the (seed, cursor) counter pair);
+# ids 6-9 are the dense tick's streams (folded onto the (key, tick)
+# counter pair).  The two families never share a base key, so the id
+# spaces could overlap — they stay disjoint anyway so a leap report line
+# names its phase unambiguously.
+STREAM_PROXY = 0  # proxy slot picks for ping-req fan-out
+STREAM_CHAIN = 1  # the four delivery legs of each indirect-ping chain
+STREAM_DRAW = 2  # ping target pick among the oldest-k Known slots
+STREAM_PING = 3  # direct ping delivery bernoulli
+STREAM_ACK = 4  # ack delivery bernoulli
+STREAM_GOSSIP = 5  # piggyback share slot picks
+STREAM_TICK_PROXY = 6  # dense tick: proxy member choose-k for escalation
+STREAM_TICK_PING = 7  # dense tick: ping target pick / candidate choice
+STREAM_TICK_BERN = 8  # dense tick: join-reply bernoulli matrix
+STREAM_TICK_DROP = 9  # dense tick: datagram drop uniform matrix
+
+
+def stream_table() -> dict[str, int]:
+    """Live ``{name: id}`` view of every ``STREAM_*`` constant, in id order.
+
+    Read off the module's attributes at call time (not a frozen copy), so
+    keyscope's double-entry check (analysis/rng/rules.py
+    ``KEYSCOPE_STREAMS``) sees exactly what the kernels will fold in —
+    including any renumbering a bad edit (or a mutation test) introduces."""
+    import sys
+
+    mod = sys.modules[__name__]
+    table = {
+        name: getattr(mod, name)
+        for name in dir(mod)
+        if name.startswith("STREAM_") and isinstance(getattr(mod, name), int)
+    }
+    return dict(sorted(table.items(), key=lambda kv: (kv[1], kv[0])))
+
+
+def stream_key(seed: jax.Array, cursor: jax.Array, stream: int) -> jax.Array:
+    """Threefry key for one phase of one tick — pure function of the counters."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
+    return jax.random.fold_in(base, jnp.uint32(stream))
+
+
+def stream_uniform(
+    seed: jax.Array, cursor: jax.Array, stream: int, shape: tuple[int, ...]
+) -> jax.Array:
+    """Shaped float32 uniform in [0, 1) for one phase (position = row/slot)."""
+    # f32 pinned: draw values feed thresholds and floor(u * count) index
+    # math where f64 would shift pick boundaries (same pin as ops/sampling).
+    return jax.random.uniform(
+        stream_key(seed, cursor, stream), shape, dtype=jnp.float32
+    )
+
+
+def tick_stream_key(key: jax.Array, tick: jax.Array, stream: int) -> jax.Array:
+    """Dense-tick threefry key for ``(key, tick, stream)`` — no chain.
+
+    ``key`` is the raw ``uint32[2]`` carried in ``MeshState``; collapsing
+    it to one seed word re-roots the derivation in a fresh ``PRNGKey`` so
+    keyscope's provenance walker sees ``counter_seed`` (leapable), not
+    ``carried_key`` (chain-coupled).  The tick fold is the counter; the
+    stream fold separates the phases of one tick."""
+    seed = key[0] ^ key[1]
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), tick)
+    return jax.random.fold_in(base, jnp.uint32(stream))
+
+
+def tick_draw_keys(
+    key: jax.Array, tick: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The dense tick's four draw keys ``(proxy, ping, bern, drop)``.
+
+    Replaces ``split_tick_keys``'s 5-way chain fork: same four draw rows,
+    but each a pure function of ``(key, tick)`` — and no ``next`` row,
+    because the carried key plane is constant under the counter scheme."""
+    return (
+        tick_stream_key(key, tick, STREAM_TICK_PROXY),
+        tick_stream_key(key, tick, STREAM_TICK_PING),
+        tick_stream_key(key, tick, STREAM_TICK_BERN),
+        tick_stream_key(key, tick, STREAM_TICK_DROP),
+    )

@@ -6,7 +6,7 @@ span (horizon.py's quiescence predicate + no scheduled events) every op
 the planner marks ``invariant`` is provably a fixed point, and
 ``plan(graph, "span")`` prunes them — ``make_leap_fn`` checks at build
 time that the planner's surviving op set is exactly what this module
-implements (draw = rng_split + probe_draw, refresh = the degenerate
+implements (draw = rng_streams + probe_draw, refresh = the degenerate
 call1/call2 timer-restamp + latency-decay forms, ledger = the
 anti-entropy/finish fixed-point writes), so a new op added to the graph
 cannot silently leak past the leap. Concretely, the dense tick collapses:
@@ -26,10 +26,11 @@ work is
   otherwise via the wave-2 ack mark, never both (kernel.py ``_fast``'s
   two-wave sampling order, degenerate inside the span).
 
-Because the PRNG is counter-based, the k ticks' draws do not need the k
-sequential tick dispatches that produce them in the dense kernel: the
-per-tick key chain is k cheap ``split``\\s (O(1) each, no [N, N] work) and
-the k uniform vectors are generated as ONE ``[k, N]`` batch up front.
+Because the PRNG is counter-keyed (Warp 3.0, phasegraph/rng.py), the k
+ticks' draws do not need ANY sequential key work: each in-span tick's
+ping key is ``tick_stream_key(st.key, t, STREAM_TICK_PING)`` — a pure
+function of the (constant) key plane and the tick index — and the k
+uniform vectors are generated as ONE ``[k, N]`` batch up front.
 
 The remaining sequential dependence — tick s's draw ranks timers that tick
 s-1 refreshed — is paid with O(N·W) work per tick instead of the dense
@@ -48,10 +49,10 @@ Draw parity: the per-segment and cross-segment reductions compute exactly
 the stable k-smallest ordering of ``ops.sampling._stable_k_smallest_iter``
 (score-then-column lexicographic, ties toward the lower column), and the
 selection tail (``ops.sampling.pick_candidate``) is literally shared with
-the dense kernel — same uniform in, bit-identical target out. The key chain
-replicates the dense tick's ``split(key, 5)`` layout (ping key = row 1,
-next = row 4), so the span exits with the exact key the dense run would
-carry.
+the dense kernel — same uniform in, bit-identical target out. The ping
+keys replicate the dense tick's ``STREAM_TICK_PING`` counter derivation
+exactly, and the carried key plane is constant under the counter scheme,
+so the span exits with the exact key the dense run would carry.
 
 Fixed-point writes the leap must still perform once (the dense tick rewrites
 them every tick): ``kpr_partner = -1``, ``kpr_fp = fingerprint``, ``kpr_n =
@@ -70,7 +71,7 @@ from kaboodle_tpu.config import SwimConfig
 from kaboodle_tpu.ops.hashing import membership_fingerprint
 from kaboodle_tpu.ops.sampling import pick_candidate
 from kaboodle_tpu.phasegraph.graph import build_graph
-from kaboodle_tpu.phasegraph.ops import KEY_NEXT, KEY_PING, split_tick_keys
+from kaboodle_tpu.phasegraph import rng as pg_rng
 from kaboodle_tpu.phasegraph.plan import plan
 from kaboodle_tpu.sim.state import MeshState
 from kaboodle_tpu.spec import KNOWN
@@ -79,7 +80,7 @@ from kaboodle_tpu.spec import KNOWN
 # build if the planner derives anything else from the graph (the leap would
 # no longer be bit-exact with k dense ticks).
 _SPAN_PASSES = {
-    "draw": ("rng_split", "probe_draw"),
+    "draw": ("rng_streams", "probe_draw"),
     "refresh": ("call1", "call2"),
     "ledger": ("anti_entropy", "finish"),
 }
@@ -90,7 +91,7 @@ _SPAN_PASSES = {
 # provably inserts nothing, so its whole effect is two timer marks per
 # delivered request plus the kpr ledger rewrite — both modeled exactly.
 _HYBRID_PASSES = {
-    "draw": ("rng_split", "probe_draw"),
+    "draw": ("rng_streams", "probe_draw"),
     "refresh": ("call1", "call2"),
     "ae": ("anti_entropy",),
     "ledger": ("finish",),
@@ -252,14 +253,10 @@ def make_leap_fn(
 
         if not masked:
             # ---- the [k, ...] draw batch (counter-based PRNG) -------------
-            # Key chain: the dense tick derives ops.KEY_LAYOUT rows from
-            # split(key, 5) and carries the `next` row; only the ping key
-            # is ever consumed on a quiescent tick.
-            def key_step(key, _):
-                ks = split_tick_keys(key)
-                return ks[KEY_NEXT], ks[KEY_PING]
-
-            key_final, ping_keys = jax.lax.scan(key_step, st.key, None, length=k)
+            # Warp 3.0: each in-span tick's ping key is a pure function of
+            # (st.key, tick, STREAM_TICK_PING) — no chain to advance, so
+            # the whole batch derives directly from the [k] tick vector and
+            # the carried key plane is constant across the span.
             ticks = st.tick + jnp.arange(k, dtype=jnp.int32)  # [k] in-span ticks
             if det:
                 xs = (ticks, jnp.zeros((k, 1), dtype=jnp.float32))  # u unused
@@ -269,30 +266,41 @@ def make_leap_fn(
                 xs = (
                     ticks,
                     jax.vmap(
-                        lambda kp: jax.random.uniform(kp, (n,), dtype=jnp.float32)
-                    )(ping_keys),
+                        lambda tt: jax.random.uniform(
+                            pg_rng.tick_stream_key(
+                                st.key, tt, pg_rng.STREAM_TICK_PING
+                            ),
+                            (n,),
+                            dtype=jnp.float32,
+                        )
+                    )(ticks),
                 )
         else:
-            # Masked mode: the key chain must advance exactly k_m times, so
-            # it rides the carry and splits under the step mask.
+            # Masked mode: counter keys need no chain advance, so the step
+            # index is the only scanned input — each active step derives its
+            # ping key from (st.key, st.tick + step) in the body.
             xs = jnp.arange(k, dtype=jnp.int32)
 
         seg = jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W] within-segment
 
         def body(carry, x):
             if hybrid:
-                scores, sum_t, sum_c, lat, key, kprp, kprf, kprn = carry
+                scores, sum_t, sum_c, lat, kprp, kprf, kprn = carry
             else:
-                scores, sum_t, sum_c, lat, key = carry
+                scores, sum_t, sum_c, lat = carry
             if masked:
                 step = x
                 active = step < k_m
-                ks = split_tick_keys(key)
-                key = jnp.where(active, ks[KEY_NEXT], key)
                 t = st.tick + step
                 u_t = (
                     None if det
-                    else jax.random.uniform(ks[KEY_PING], (n,), dtype=jnp.float32)
+                    else jax.random.uniform(
+                        pg_rng.tick_stream_key(
+                            st.key, t, pg_rng.STREAM_TICK_PING
+                        ),
+                        (n,),
+                        dtype=jnp.float32,
+                    )
                 )
             else:
                 t, u_t = x
@@ -408,21 +416,20 @@ def make_leap_fn(
                     kprn = jnp.where(active, n_row, kprn)
                 else:
                     kprp, kprf, kprn = led_p, fp, n_row
-                return (scores, sum_t, sum_c, lat, key, kprp, kprf, kprn), None
-            return (scores, sum_t, sum_c, lat, key), None
+                return (scores, sum_t, sum_c, lat, kprp, kprf, kprn), None
+            return (scores, sum_t, sum_c, lat), None
 
-        key0 = st.key  # advanced in the carry only in masked mode
         if hybrid:
             carry0 = (
-                pin(scores0), pin(sum_t0), pin(sum_c0), lat, key0,
+                pin(scores0), pin(sum_t0), pin(sum_c0), lat,
                 st.kpr_partner, st.kpr_fp, st.kpr_n,
             )
-            (scores_k, _, _, lat_k, key_k, kprp_k, kprf_k, kprn_k), _ = (
+            (scores_k, _, _, lat_k, kprp_k, kprf_k, kprn_k), _ = (
                 jax.lax.scan(body, carry0, xs)
             )
         else:
-            carry0 = (pin(scores0), pin(sum_t0), pin(sum_c0), lat, key0)
-            (scores_k, _, _, lat_k, key_k), _ = jax.lax.scan(body, carry0, xs)
+            carry0 = (pin(scores0), pin(sum_t0), pin(sum_c0), lat)
+            (scores_k, _, _, lat_k), _ = jax.lax.scan(body, carry0, xs)
             # Anti-entropy ledger at the span's final tick (fixed point,
             # written once): no request in flight, fingerprint + map size.
             fp = membership_fingerprint(
@@ -443,7 +450,7 @@ def make_leap_fn(
             timer=jnp.where(elig, scores_k[:, :n], T),
             latency=lat_k,
             tick=st.tick + (k_m if masked else k),
-            key=key_k if masked else key_final,
+            key=st.key,  # the carried key plane is constant under Warp 3.0
             kpr_partner=kprp_k,
             kpr_fp=kprf_k,
             kpr_n=kprn_k,

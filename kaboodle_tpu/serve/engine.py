@@ -82,7 +82,9 @@ from kaboodle_tpu.telemetry.manifest import run_record
 from kaboodle_tpu.warp.horizon import decode_signature
 from kaboodle_tpu.warp.runner import (
     MIN_LEAP,
+    SpanMemo,
     WarpLedger,
+    _check_warp_mode,
     _classify,
     _leap_budget,
 )
@@ -178,6 +180,8 @@ class ServeEngine:
         spills_per_round: int = 1,
         obs=None,
         engine_id: str | None = None,
+        warp_memo: SpanMemo | bool | None = None,
+        warp_mode: str = "exact",
     ) -> None:
         self.pools: dict[int, LanePool] = {}
         for pool in pools:
@@ -190,6 +194,21 @@ class ServeEngine:
         self.max_leap = int(max_leap)
         if self.max_leap < MIN_LEAP:
             raise ValueError(f"need max_leap >= MIN_LEAP ({MIN_LEAP})")
+        # Warp 3.0: signature-keyed span memo for the leap rounds.
+        # ``True`` adopts the process-shared ``span_memo`` (serve lanes,
+        # fleet drains, and sim runs then trade deltas); a ``SpanMemo``
+        # instance scopes the cache to this engine; ``None`` turns
+        # memoization off (every leap round dispatches, as before).
+        _check_warp_mode(warp_mode)
+        self.warp_mode = warp_mode
+        if warp_memo is True:
+            from kaboodle_tpu.warp.runner import span_memo
+
+            self.warp_memo: SpanMemo | None = span_memo
+        elif warp_memo is False:
+            self.warp_memo = None
+        else:
+            self.warp_memo = warp_memo
         self.spill_after = spill_after
         self.spill_dir = spill_dir
         # Federation identity: namespaces this engine's spill files under
@@ -898,7 +917,7 @@ class ServeEngine:
         decoded: list[tuple] = []  # (cls, mode) per horizon lane
         for e in np.flatnonzero(horizon):
             cls = decode_signature(rows[e])
-            mode = _classify(cls, hybrid=True)
+            mode = _classify(cls, hybrid=True, warp_mode=self.warp_mode)
             if mode != "dense":
                 k_m[e] = min(
                     _leap_budget(cls, mode, int(pool.remaining[e])),
@@ -923,11 +942,13 @@ class ServeEngine:
         K = max(K, MIN_LEAP)
         if tracing:
             t0_us = self.obs.now_us()
-        pool.leap(K, k_m)
+        memo_hits, dispatched = pool.leap(K, k_m, memo=self.warp_memo)
         pool.advance_leaped(k_m)
         self._emit(
-            "serve_round", round=self.round, pool_n=pool.n, engine="leap",
+            "serve_round", round=self.round, pool_n=pool.n,
+            engine="leap" if dispatched else "leap+memo",
             lanes=int((k_m > 0).sum()), ticks=int(k_m.sum()), bucket=K,
+            memo_hits=memo_hits,
         )
         if tracing:
             # One advance span per pool round, each leaping lane annotated

@@ -425,6 +425,22 @@ class ObsPlane:
         m.register_gauge("warp_leap_cache_misses", _leap_cache("misses"))
         m.register_gauge("warp_leap_cache_programs", _leap_cache("programs"))
 
+        # Warp 3.0 span memo (signature-keyed state deltas). Reads the
+        # engine's bound memo — engines without one report zeros, so the
+        # gauge set is stable across configurations.
+        def _span_memo(field):
+            def read():
+                memo = getattr(engine, "warp_memo", None)
+                return memo.stats()[field] if memo is not None else 0
+
+            return read
+
+        m.register_gauge("warp_span_memo_hits", _span_memo("hits"))
+        m.register_gauge("warp_span_memo_misses", _span_memo("misses"))
+        m.register_gauge("warp_span_memo_entries", _span_memo("entries"))
+        m.register_gauge("warp_span_memo_bytes", _span_memo("bytes"))
+        m.register_gauge("warp_span_memo_evictions", _span_memo("evictions"))
+
         def _cache_kind_hit_rates():
             from kaboodle_tpu.warp.runner import leap_cache
 

@@ -404,14 +404,30 @@ class LanePool:
 
         return _fleet_signature(self.cfg)(self.mesh)
 
-    def leap(self, K: int, k_m: np.ndarray) -> None:
+    def leap(self, K: int, k_m: np.ndarray, memo=None) -> tuple[int, bool]:
         """One masked fleet-leap dispatch (bucket ``K``): every lane
         advances its own ``k_m[e] <= K`` ticks; ``k_m[e] == 0`` freezes
         the lane bit-exactly. Host budget accounting is the caller's
-        :meth:`advance_leaped` — this moves only the device mesh."""
-        from kaboodle_tpu.warp.runner import _get_fleet_leap
+        :meth:`advance_leaped` — this moves only the device mesh.
 
-        self.mesh = _get_fleet_leap(self.cfg, K)(self.mesh, jnp.asarray(k_m))
+        With a Warp 3.0 ``SpanMemo``, the round goes through
+        :func:`~kaboodle_tpu.warp.runner.memo_fleet_leap`: per-lane span
+        deltas are keyed by (entry-row digest, ``k_m[e]``), so a drain one
+        lane already computed replays as a host XOR on every other lane —
+        and when ALL leaping lanes hit, the dispatch is skipped outright.
+        Returns ``(memo_hits, dispatched)`` (``(0, True)`` without a
+        memo)."""
+        from kaboodle_tpu.warp.runner import _get_fleet_leap, memo_fleet_leap
+
+        prog = _get_fleet_leap(self.cfg, K)
+        if memo is None:
+            self.mesh = prog(self.mesh, jnp.asarray(k_m))
+            return 0, True
+        family = repr((self.cfg, "serve"))
+        self.mesh, hits, dispatched = memo_fleet_leap(
+            family, self.mesh, np.asarray(k_m), memo, prog
+        )
+        return hits, dispatched
 
     def agreement(self):
         """Vmapped end-state agreement rows ``(converged, fp_min, fp_max,

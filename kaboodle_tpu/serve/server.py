@@ -403,6 +403,18 @@ def main(argv=None) -> int:
     parser.add_argument("--max-leap", type=int, default=256)
     parser.add_argument("--no-warp", action="store_true",
                         help="disable horizon-lane fast-forward")
+    parser.add_argument("--no-warp-memo", action="store_true",
+                        help="disable Warp 3.0 span-delta memoization (on "
+                             "by default: a lane pool entering a banked "
+                             "state replays the delta host-side instead of "
+                             "dispatching the leap — bit-identical, "
+                             "warp_span_memo_* gauges on --obs)")
+    parser.add_argument("--warp-mode", choices=["exact", "distributional"],
+                        default="exact",
+                        help="leap tier: 'exact' (default) is bit-exact "
+                             "with dense ticking; 'distributional' admits "
+                             "live-A2 drain spans to the hybrid leap — "
+                             "distribution-pinned, NOT bit-exact")
     parser.add_argument("--telemetry", action="store_true",
                         help="per-lane protocol counter totals (disables warp)")
     parser.add_argument("--manifest", default=None,
@@ -521,6 +533,7 @@ def main(argv=None) -> int:
         admission = AdmissionController(max_queue=args.max_queue)
     engine = ServeEngine(
         pools, warp=not args.no_warp, max_leap=args.max_leap,
+        warp_memo=not args.no_warp_memo, warp_mode=args.warp_mode,
         spill_after=args.spill_after, spill_dir=args.spill_dir,
         sync_spill=args.sync_spill, journal_dir=args.journal_dir,
         admission=admission, engine_id=args.engine_id,

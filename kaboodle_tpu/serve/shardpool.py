@@ -234,10 +234,16 @@ class ShardedLanePool(LanePool):
 
     # -- warp dispatch hooks -----------------------------------------------
 
-    def leap(self, K: int, k_m) -> None:
+    def leap(self, K: int, k_m, memo=None) -> tuple[int, bool]:
+        # The Warp 3.0 span memo is deliberately inert here: keying a lane
+        # requires digesting its rows on the host, and fetching a
+        # GSPMD-sharded mesh back every round would serialize the exact
+        # cross-device reassembly the sharded pool exists to avoid. Rounds
+        # always dispatch; the base pool is the memo tier.
         self.mesh = _sharded_fleet_leap(self.cfg, K, self.device_mesh)(
             self.mesh, jnp.asarray(k_m)
         )
+        return 0, True
 
     # -- warmup ------------------------------------------------------------
 

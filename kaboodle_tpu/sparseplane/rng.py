@@ -1,65 +1,45 @@
-"""Counter-based threefry streams for the blocked-sparse tick.
+"""Counter-based threefry streams for the blocked-sparse tick (re-export).
 
-The dense engines carry a threefry key through the state and split it each
-tick; the blocked layout instead derives every draw on the fly from the
-``(seed, cursor)`` counter pair stored in ``SparseState``:
-
-    key(stream) = fold_in(fold_in(PRNGKey(seed), cursor), stream)
-
-and then takes a *shaped* uniform from that key, so the element position
-inside the draw supplies the remaining counter words — a ``(N, K)`` draw is
-effectively keyed ``(seed, tick, stream, row, slot)``.  Nothing ``[N, N]``
-is ever materialized, draws are reproducible from the checkpointable
-``cursor`` alone, and distinct ``STREAM_*`` ids keep the per-phase draws
-independent (no key reuse across phases — the same discipline KB204
-enforces on the dense engines).
+Warp 3.0 promoted this module's ``(seed, cursor, stream)`` scheme into the
+shared :mod:`kaboodle_tpu.phasegraph.rng` counter-RNG module so the dense
+engines could adopt the same discipline (per-``(key, tick, stream)`` keys
+instead of the split-chain fork).  The canonical stream table and key
+derivations live there now; this module re-exports the sparse-facing names
+so kernel code and call sites keep their historical import path.  Mutation
+tests and the KB602 double-entry register target the canonical module —
+patch/edit ``phasegraph/rng.py``, not this shim.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from kaboodle_tpu.phasegraph.rng import (  # noqa: F401
+    STREAM_ACK,
+    STREAM_CHAIN,
+    STREAM_DRAW,
+    STREAM_GOSSIP,
+    STREAM_PING,
+    STREAM_PROXY,
+    STREAM_TICK_BERN,
+    STREAM_TICK_DROP,
+    STREAM_TICK_PING,
+    STREAM_TICK_PROXY,
+    stream_key,
+    stream_table,
+    stream_uniform,
+)
 
-# One id per randomized phase of the sparse tick, in tick order.  New phases
-# append — renumbering changes every draw of every banked run.
-STREAM_PROXY = 0  # proxy slot picks for ping-req fan-out
-STREAM_CHAIN = 1  # the four delivery legs of each indirect-ping chain
-STREAM_DRAW = 2  # ping target pick among the oldest-k Known slots
-STREAM_PING = 3  # direct ping delivery bernoulli
-STREAM_ACK = 4  # ack delivery bernoulli
-STREAM_GOSSIP = 5  # piggyback share slot picks
-
-
-def stream_table() -> dict[str, int]:
-    """Live ``{name: id}`` view of every ``STREAM_*`` constant, in id order.
-
-    Read off the module's attributes at call time (not a frozen copy), so
-    keyscope's double-entry check (analysis/rng/rules.py
-    ``KEYSCOPE_STREAMS``) sees exactly what the kernel will fold in —
-    including any renumbering a bad edit (or a mutation test) introduces."""
-    import sys
-
-    mod = sys.modules[__name__]
-    table = {
-        name: getattr(mod, name)
-        for name in dir(mod)
-        if name.startswith("STREAM_") and isinstance(getattr(mod, name), int)
-    }
-    return dict(sorted(table.items(), key=lambda kv: (kv[1], kv[0])))
-
-
-def stream_key(seed: jax.Array, cursor: jax.Array, stream: int) -> jax.Array:
-    """Threefry key for one phase of one tick — pure function of the counters."""
-    base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)
-    return jax.random.fold_in(base, jnp.uint32(stream))
-
-
-def stream_uniform(
-    seed: jax.Array, cursor: jax.Array, stream: int, shape: tuple[int, ...]
-) -> jax.Array:
-    """Shaped float32 uniform in [0, 1) for one phase (position = row/slot)."""
-    # f32 pinned: draw values feed thresholds and floor(u * count) index
-    # math where f64 would shift pick boundaries (same pin as ops/sampling).
-    return jax.random.uniform(
-        stream_key(seed, cursor, stream), shape, dtype=jnp.float32
-    )
+__all__ = [
+    "STREAM_PROXY",
+    "STREAM_CHAIN",
+    "STREAM_DRAW",
+    "STREAM_PING",
+    "STREAM_ACK",
+    "STREAM_GOSSIP",
+    "STREAM_TICK_PROXY",
+    "STREAM_TICK_PING",
+    "STREAM_TICK_BERN",
+    "STREAM_TICK_DROP",
+    "stream_key",
+    "stream_table",
+    "stream_uniform",
+]

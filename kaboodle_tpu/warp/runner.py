@@ -47,8 +47,10 @@ the lockstep tax: one mid-boot member keeps only itself dense.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import hashlib
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +61,9 @@ from kaboodle_tpu.sim.kernel import make_tick_fn
 from kaboodle_tpu.sim.runner import state_converged
 from kaboodle_tpu.sim.state import MeshState, TickInputs, idle_inputs
 from kaboodle_tpu.warp.horizon import (
+    HYBRID_BITS,
+    SIG_ANY_A2,
+    SIG_WAIT_ALIVE,
     ActivityClass,
     decode_signature,
     make_quiescence_fn,
@@ -143,6 +148,155 @@ class ProgramCache:
 
 
 leap_cache = ProgramCache()
+
+
+# ---------------------------------------------------------------------------
+# Warp 3.0: signature-keyed span memoization
+#
+# The counter-keyed RNG (phasegraph/rng.py) makes every span's effect a pure
+# function of its entry state: the carried key plane is constant and each
+# tick's draws derive from (key, tick, stream), so two spans entering the
+# same state at the same tick compute the SAME exit state. SpanMemo exploits
+# that purity — it caches the span's state *delta* (byte-XOR of entry vs
+# exit leaves, exact for every dtype) keyed by the span identity (program
+# family, engine kind, span length, ActivityClass key) plus blake2b digests
+# of the entry state (and, for dense spans, the consumed input slice), and
+# replays the delta when the same span recurs — across runs, fleet members
+# and serve lanes. Replay is host XOR + one device_put per leaf: no
+# dispatch, no compile, bit-identical exit state (the digest pins the entry
+# bytes; XOR then reproduces the exit bytes exactly), so memo-on == memo-off
+# is an invariant the dryrun bit-diffs. The legacy chain-keyed scheme could
+# never do this: its key plane encoded the whole draw history, so no two
+# spans ever re-entered the same state.
+
+
+def _host_leaves(tree) -> list[np.ndarray]:
+    """Pull a pytree's leaves to host (np views/copies, flatten order)."""
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def _digest_leaves(leaves) -> bytes:
+    """blake2b-128 over the raw bytes of every leaf, in flatten order."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in leaves:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def _xor_delta(entry: np.ndarray, exit_: np.ndarray) -> np.ndarray:
+    """uint8 XOR of two same-shape arrays' raw bytes (exact, dtype-blind)."""
+    a = np.frombuffer(np.ascontiguousarray(entry).tobytes(), np.uint8)
+    b = np.frombuffer(np.ascontiguousarray(exit_).tobytes(), np.uint8)
+    return a ^ b
+
+
+def _apply_xor(entry: np.ndarray, delta: np.ndarray) -> np.ndarray:
+    out = np.frombuffer(np.ascontiguousarray(entry).tobytes(), np.uint8) ^ delta
+    return np.frombuffer(out.tobytes(), entry.dtype).reshape(entry.shape)
+
+
+class SpanMemo:
+    """Bounded LRU cache of span state-deltas, keyed by span signature.
+
+    Entries: ``key -> (deltas, metrics, nbytes)`` where ``deltas`` is the
+    per-leaf uint8 XOR of entry vs exit bytes and ``metrics`` an optional
+    list of per-tick host metric pytrees a dense span must re-emit on
+    replay. Both bounds are hard: inserting past ``max_bytes`` or
+    ``max_entries`` evicts least-recently-used entries first (the warp3
+    dryrun asserts the bound holds under churn). Per-kind hit/miss stats
+    feed the WarpLedger summary, the serve MetricsRegistry gauges and the
+    bench capture. Host-side only — a hit replays the exact exit bytes, so
+    memo-on and memo-off runs are bit-identical by construction."""
+
+    def __init__(self, max_bytes: int = 256 << 20, max_entries: int = 4096) -> None:
+        self.max_bytes = int(max_bytes)
+        self.max_entries = int(max_entries)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._kind_stats: dict[str, list[int]] = {}  # kind -> [hits, misses]
+
+    def get(self, key, kind: str = "span"):
+        stats = self._kind_stats.setdefault(kind, [0, 0])
+        hit = self._entries.get(key)
+        if hit is None:
+            self.misses += 1
+            stats[1] += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        stats[0] += 1
+        return hit[0]
+
+    def put(self, key, value, nbytes: int) -> None:
+        if key in self._entries or nbytes > self.max_bytes:
+            return
+        self._entries[key] = (value, int(nbytes))
+        self.bytes += int(nbytes)
+        while self._entries and (
+            self.bytes > self.max_bytes or len(self._entries) > self.max_entries
+        ):
+            _, (_, old) = self._entries.popitem(last=False)
+            self.bytes -= old
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        per_kind = {
+            kind: {
+                "hits": h,
+                "misses": m,
+                "hit_rate": round(h / (h + m), 4) if h + m else 0.0,
+            }
+            for kind, (h, m) in sorted(self._kind_stats.items())
+        }
+        return {
+            "entries": len(self._entries),
+            "bytes": self.bytes,
+            "max_bytes": self.max_bytes,
+            "max_entries": self.max_entries,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "per_kind": per_kind,
+        }
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.bytes = 0
+        self.hits = self.misses = self.evictions = 0
+        self._kind_stats.clear()
+
+
+# The default shared instance: CLI runs, the serve engine and the bench
+# arms all hit one cache, which is what lets a serve lane replay a drain
+# another lane (or an earlier run in the same process) already computed.
+span_memo = SpanMemo()
+
+
+def _memo_store(memo: SpanMemo, key, entry_leaves, exit_state, metrics=None) -> None:
+    """Bank one span: per-leaf XOR deltas (+ optional per-tick metrics)."""
+    exit_leaves = _host_leaves(exit_state)
+    deltas = [_xor_delta(a, b) for a, b in zip(entry_leaves, exit_leaves)]
+    nbytes = sum(d.nbytes for d in deltas)
+    if metrics is not None:
+        nbytes += sum(
+            int(l.nbytes) for m in metrics for l in jax.tree.leaves(m)
+        )
+    memo.put(key, (deltas, metrics), nbytes)
+
+
+def _memo_replay(state, entry_leaves, deltas):
+    """Rebuild the exit state from the entry leaves + banked deltas."""
+    leaves, treedef = jax.tree.flatten(state)
+    new = [
+        jnp.asarray(_apply_xor(a, d)) for a, d in zip(entry_leaves, deltas)
+    ]
+    assert len(new) == len(leaves)
+    return jax.tree.unflatten(treedef, new)
 
 
 def _span_chunks(k: int) -> tuple[list[int], int]:
@@ -305,15 +459,51 @@ class WarpLedger:
         return out
 
 
-def _classify(cls: ActivityClass, hybrid: bool, telemetry: bool = False) -> str:
+# Modes the runners accept. ``exact`` is the default and bit-exact with
+# dense ticking. ``distributional`` (Warp 3.0) additionally admits classes
+# with live A2 escalation / wait-alive activity to the hybrid program:
+# their in-span escalation side effects are approximated by the hybrid
+# model (pings delivered and acked), while every timer-expiry tick still
+# runs dense, so suspicion maturation, death marking and gossip all happen
+# — at statistically, not bit-wise, identical ticks. Pinned by its own
+# fuzz arm (convergence-tick band + steady counter means), never by the
+# bit-diff suites.
+WARP_MODES = ("exact", "distributional")
+_DISTRIBUTIONAL_BITS = HYBRID_BITS | SIG_ANY_A2 | SIG_WAIT_ALIVE
+
+
+def _check_warp_mode(warp_mode: str) -> None:
+    if warp_mode not in WARP_MODES:
+        raise ValueError(f"warp_mode {warp_mode!r} not in {WARP_MODES}")
+
+
+def _classify(
+    cls: ActivityClass,
+    hybrid: bool,
+    telemetry: bool = False,
+    warp_mode: str = "exact",
+) -> str:
     """The engine a span entry state maps to, under the runner's knobs.
 
     ``hybrid=False`` (the Warp 1.x behavior knob) demotes hybrid-class
     states to dense; telemetry mode does too — hybrid spans carry
     data-dependent anti-entropy gossip bytes with no closed form, so exact
     counter totals require measuring those ticks densely (strictly
-    quiescent spans keep the ``leap_counters`` closed form)."""
+    quiescent spans keep the ``leap_counters`` closed form).
+    ``warp_mode="distributional"`` promotes dense classes whose only
+    extra activity is A2 escalation / wait-alive traffic to the hybrid
+    program (module comment above) — classes carrying joins, known-dead
+    or missing-alive cells, identity staleness or too-few-known rows stay
+    dense in every mode (the hybrid model cannot even approximate their
+    effects without stalling convergence)."""
     mode = cls.mode
+    if (
+        warp_mode == "distributional"
+        and mode == "dense"
+        and cls.bits
+        and not (cls.bits & ~_DISTRIBUTIONAL_BITS)
+    ):
+        mode = "hybrid"
     if mode == "hybrid" and (not hybrid or telemetry):
         return "dense"
     return mode
@@ -337,6 +527,8 @@ def simulate_warped(
     telemetry: bool = False,
     hybrid: bool = True,
     ledger: WarpLedger | None = None,
+    memo: SpanMemo | None = None,
+    warp_mode: str = "exact",
 ):
     """Run a stacked ``[T]`` schedule, fast-forwarding (near-)quiescent spans.
 
@@ -369,15 +561,24 @@ def simulate_warped(
     anti-entropy gossip bytes have no closed form), so totals stay exact;
     ``n_alive`` rides the signature fetch, keeping the one-fetch-per-span
     budget.
+
+    ``memo``, when given, banks every span's state delta in the
+    :class:`SpanMemo` and replays recurring spans (leaped AND dense) from
+    it — bit-identical exit states and re-emitted dense metrics, no
+    dispatches. ``warp_mode="distributional"`` widens the hybrid class
+    (see :func:`_classify`); the default ``"exact"`` stays bit-exact with
+    dense ticking.
     """
     from kaboodle_tpu.telemetry.counters import counters_totals, leap_counters
     from kaboodle_tpu.telemetry.trace import host_span
 
+    _check_warp_mode(warp_mode)
     T = int(np.asarray(inputs.kill).shape[0])
     eventful = static_event_ticks(inputs)
     tick = _dense_tick(cfg, faulty, mesh, telemetry)
     signature = make_signature_fn(cfg)
     recheck_every = max(1, int(recheck_every))
+    family = repr((cfg, mesh, faulty, telemetry, warp_mode))
     dense_ticks: list[int] = []
     metrics = []
     leap_spans: list[tuple[int, int]] = []  # (span length, n_alive)
@@ -386,7 +587,7 @@ def simulate_warped(
         if not eventful[t]:
             span_end = next_static_event(eventful, t)
             cls = decode_signature(signature(state))
-            mode = _classify(cls, hybrid, telemetry)
+            mode = _classify(cls, hybrid, telemetry, warp_mode)
             k = _leap_budget(cls, mode, span_end - t) if mode != "dense" else 0
             chunks, rem = _span_chunks(k)
             if chunks:
@@ -395,9 +596,27 @@ def simulate_warped(
                     on_boundary(t, state)
                 if telemetry:
                     leap_spans.append((k, cls.n_alive))
+                entry_leaves = memo_key = None
+                if memo is not None:
+                    entry_leaves = _host_leaves(state)
+                    memo_key = (
+                        "leap", family, mode, k, cls.key,
+                        _digest_leaves(entry_leaves),
+                    )
+                    hit = memo.get(memo_key, kind=mode)
+                    if hit is not None:
+                        state = _memo_replay(state, entry_leaves, hit[0])
+                        if ledger is not None:
+                            ledger.record(cls, mode + "+memo", k, 0)
+                        t += k
+                        if on_boundary is not None:
+                            on_boundary(t, state)
+                        continue
                 with host_span(f"leap_span:{mode}:{k}"):
                     for chunk in chunks:
                         state = _get_leap(cfg, chunk, mesh, mode == "hybrid")(state)
+                if memo is not None:
+                    _memo_store(memo, memo_key, entry_leaves, state)
                 if ledger is not None:
                     ledger.record(cls, mode, k, len(chunks))
                 t += k
@@ -405,11 +624,69 @@ def simulate_warped(
                     on_boundary(t, state)
                 continue
             stop = min(span_end, t + recheck_every)
-            if ledger is not None:
-                ledger.record_blocked(cls, stop - t, "sim", mode=mode)
+            blocked_cls = cls
         else:
             stop = t + 1
+            cls, mode = None, "dense"
+            blocked_cls = None
+        if memo is not None and not telemetry:
+            # Dense spans memoize too (the Warp 3.0 point: the counter
+            # keys make even a drain season's dense quantum a pure
+            # function of its entry state + input slice). The key folds
+            # in the consumed schedule slice so eventful ticks and
+            # differing drop/churn planes never collide.
+            entry_leaves = _host_leaves(state)
+            in_slice = jax.tree.map(lambda x: x[t:stop], inputs)
+            memo_key = (
+                "dense", family, stop - t,
+                cls.key if cls is not None else -1,
+                _digest_leaves(entry_leaves),
+                _digest_leaves(_host_leaves(in_slice)),
+            )
+            hit = memo.get(memo_key, kind="dense")
+            if hit is not None:
+                state = _memo_replay(state, entry_leaves, hit[0])
+                dense_ticks.extend(range(t, stop))
+                metrics.extend(hit[1])
+                if ledger is not None:
+                    # A replayed dense span is NOT blocked — the memo
+                    # covered it without a single dense dispatch. The
+                    # why-dense histogram shrinks by exactly these rows.
+                    if blocked_cls is not None:
+                        ledger.record(blocked_cls, "dense+memo", stop - t, 0)
+                    else:
+                        ledger.spans.append({
+                            "engine": "dense+memo",
+                            "class_key": -1,
+                            "class": {"terms": ["scheduled_event"],
+                                      "active_row_bucket": -1},
+                            "ticks": stop - t,
+                            "dispatches": 0,
+                        })
+                t = stop
+                continue
+            span_metrics: list = []
+            with host_span("dense_span"):
+                while t < stop:
+                    state, m = tick(state, _slice_tick(inputs, t))
+                    dense_ticks.append(t)
+                    mh = jax.tree.map(np.asarray, m)
+                    metrics.append(mh)
+                    span_metrics.append(mh)
+                    t += 1
+            _memo_store(memo, memo_key, entry_leaves, state, span_metrics)
             if ledger is not None:
+                if blocked_cls is not None:
+                    ledger.record_blocked(
+                        blocked_cls, len(span_metrics), "sim", mode=mode
+                    )
+                else:
+                    ledger.record_blocked(None, 1, "sim")
+            continue
+        if ledger is not None:
+            if blocked_cls is not None:
+                ledger.record_blocked(blocked_cls, stop - t, "sim", mode=mode)
+            else:
                 # Eventful tick: the schedule forced it dense — no
                 # signature fetch (the one-fetch-per-span budget holds).
                 ledger.record_blocked(None, 1, "sim")
@@ -443,6 +720,8 @@ def run_warped(
     mesh=None,
     hybrid: bool = True,
     ledger: WarpLedger | None = None,
+    memo: SpanMemo | None = None,
+    warp_mode: str = "exact",
 ):
     """Advance a fault-free mesh exactly ``ticks`` ticks, leaping spans.
 
@@ -456,24 +735,65 @@ def run_warped(
     contract, with ``ticks_run == ticks`` always (the budget is exact, not
     a bound) and ``converged`` evaluated on the final state.
     """
+    _check_warp_mode(warp_mode)
     tick = _dense_tick(cfg, False, mesh)
     signature = make_signature_fn(cfg)
     idle = idle_inputs(state.n)
     recheck_every = max(1, int(recheck_every))
+    family = repr((cfg, mesh, "steady", warp_mode))
     t = 0
     while t < ticks:
         cls = decode_signature(signature(state))
-        mode = _classify(cls, hybrid)
+        mode = _classify(cls, hybrid, warp_mode=warp_mode)
         k = _leap_budget(cls, mode, ticks - t) if mode != "dense" else 0
         chunks, rem = _span_chunks(k)
         if chunks:
+            entry_leaves = memo_key = None
+            if memo is not None:
+                entry_leaves = _host_leaves(state)
+                memo_key = (
+                    "leap", family, mode, k - rem, cls.key,
+                    _digest_leaves(entry_leaves),
+                )
+                hit = memo.get(memo_key, kind=mode)
+                if hit is not None:
+                    state = _memo_replay(state, entry_leaves, hit[0])
+                    if ledger is not None:
+                        ledger.record(cls, mode + "+memo", k - rem, 0)
+                    t += k - rem
+                    continue
             for chunk in chunks:
                 state = _get_leap(cfg, chunk, mesh, mode == "hybrid")(state)
+            if memo is not None:
+                _memo_store(memo, memo_key, entry_leaves, state)
             if ledger is not None:
                 ledger.record(cls, mode, k - rem, len(chunks))
             t += k - rem
             continue
         stop = min(ticks, t + recheck_every)
+        if memo is not None:
+            # Idle-input dense window: the schedule is constant, so the
+            # entry state alone keys the span.
+            entry_leaves = _host_leaves(state)
+            memo_key = (
+                "dense", family, stop - t, cls.key,
+                _digest_leaves(entry_leaves),
+            )
+            hit = memo.get(memo_key, kind="dense")
+            if hit is not None:
+                state = _memo_replay(state, entry_leaves, hit[0])
+                if ledger is not None:
+                    ledger.record(cls, "dense+memo", stop - t, 0)
+                t = stop
+                continue
+            steps = stop - t
+            while t < stop:
+                state, _ = tick(state, idle)
+                t += 1
+            _memo_store(memo, memo_key, entry_leaves, state)
+            if ledger is not None:
+                ledger.record_blocked(cls, steps, "steady", mode=mode)
+            continue
         if ledger is not None:
             ledger.record_blocked(cls, stop - t, "steady", mode=mode)
         while t < stop:
@@ -529,6 +849,57 @@ def _get_fleet_leap(cfg: SwimConfig, K: int):
     return leap_cache.get((cfg, "fleet"), "hybrid", K, build)
 
 
+def memo_fleet_leap(
+    family: str,
+    mesh_state,
+    k_m: np.ndarray,
+    memo: SpanMemo,
+    dispatch,
+) -> tuple:
+    """One masked fleet/serve leap round through the span memo.
+
+    Deltas are banked PER MEMBER — keyed by the member's own ``k_m`` and
+    entry-row digest — so a drain one lane computed is a hit for every
+    other lane (and every later round) entering the same member state.
+    The masked dispatch is all-or-nothing, so it is skipped only when
+    every active member hits (the cross-lane steady state); on a partial
+    hit the round still dispatches once and banks the fresh members'
+    deltas. Members at ``k_m == 0`` are untouched by the masked program
+    and never keyed. Returns ``(new_mesh_state, hit_members,
+    dispatched)``."""
+    leaves, treedef = jax.tree.flatten(mesh_state)
+    host = [np.asarray(x) for x in leaves]
+    active = [e for e in range(len(k_m)) if k_m[e] > 0]
+    keys: dict[int, tuple] = {}
+    hits: dict[int, tuple] = {}
+    for e in active:
+        key = (
+            "fleet", family, int(k_m[e]),
+            _digest_leaves([h[e] for h in host]),
+        )
+        keys[e] = key
+        hit = memo.get(key, kind="fleet")
+        if hit is not None:
+            hits[e] = hit
+    if active and len(hits) == len(active):
+        new_host = [h.copy() for h in host]
+        for e, (deltas, _) in hits.items():
+            for i, d in enumerate(deltas):
+                new_host[i][e] = _apply_xor(host[i][e], d)
+        new_leaves = [jnp.asarray(h) for h in new_host]
+        return jax.tree.unflatten(treedef, new_leaves), len(hits), False
+    out = dispatch(mesh_state, jnp.asarray(k_m, dtype=jnp.int32))
+    out_host = [np.asarray(x) for x in jax.tree.leaves(out)]
+    for e in active:
+        if e in hits:
+            continue
+        deltas = [
+            _xor_delta(a[e], b[e]) for a, b in zip(host, out_host)
+        ]
+        memo.put(keys[e], (deltas, None), sum(d.nbytes for d in deltas))
+    return out, len(hits), True
+
+
 def fleet_quiescence_mask(fleet, cfg: SwimConfig) -> jax.Array:
     """bool ``[E]``: per-member strict event horizon (Warp 1.x surface).
 
@@ -554,6 +925,8 @@ def run_fleet_warped(
     recheck_every: int = 16,
     hybrid: bool = True,
     ledger: WarpLedger | None = None,
+    memo: SpanMemo | None = None,
+    warp_mode: str = "exact",
 ):
     """Advance every fleet member exactly ``ticks`` fault-free ticks.
 
@@ -579,10 +952,12 @@ def run_fleet_warped(
     """
     from kaboodle_tpu.fleet.core import fleet_idle_inputs
 
+    _check_warp_mode(warp_mode)
     mesh_state = fleet.mesh
     ensemble = fleet.ensemble
     idle = fleet_idle_inputs(fleet.n, ensemble)
     recheck_every = max(1, int(recheck_every))
+    family = repr((cfg, "fleet", warp_mode))
     target = None
     while True:
         rows = np.asarray(_fleet_signature(cfg)(mesh_state))  # one [E, 4] fetch
@@ -597,7 +972,7 @@ def run_fleet_warped(
         for e, cls in enumerate(classes):
             if remaining[e] <= 0:
                 continue
-            mode = _classify(cls, hybrid)
+            mode = _classify(cls, hybrid, warp_mode=warp_mode)
             if mode != "dense":
                 k_m[e] = _leap_budget(cls, mode, int(remaining[e]))
         if k_m.max() >= MIN_LEAP:
@@ -605,9 +980,16 @@ def run_fleet_warped(
             # (including sub-MIN_LEAP free riders — they share the program).
             K = 1 << int(k_m.max() - 1).bit_length()
             K = max(K, MIN_LEAP)
-            mesh_state = _get_fleet_leap(cfg, K)(
-                mesh_state, jnp.asarray(k_m, dtype=jnp.int32)
-            )
+            if memo is not None:
+                mesh_state, _, dispatched = memo_fleet_leap(
+                    family, mesh_state, k_m, memo,
+                    _get_fleet_leap(cfg, K),
+                )
+            else:
+                mesh_state = _get_fleet_leap(cfg, K)(
+                    mesh_state, jnp.asarray(k_m, dtype=jnp.int32)
+                )
+                dispatched = True
             if ledger is not None:
                 # The whole round is ONE vmapped dispatch: record one row
                 # per signature class present among the leapers (ticks
@@ -620,12 +1002,13 @@ def run_fleet_warped(
                         row[1] += int(k_m[e])
                         row[2] += 1
                 for cls, ticks_sum, members in per_round.values():
+                    engine = "fleet-" + _classify(cls, hybrid, warp_mode=warp_mode)
                     ledger.spans.append({
-                        "engine": "fleet-" + _classify(cls, hybrid),
+                        "engine": engine if dispatched else engine + "+memo",
                         "class_key": cls.key,
                         "class": cls.describe(),
                         "ticks": ticks_sum,
-                        "dispatches": 1,
+                        "dispatches": 1 if dispatched else 0,
                         "members": members,
                     })
             continue
@@ -642,7 +1025,7 @@ def run_fleet_warped(
             for e, cls in enumerate(classes):
                 if remaining[e] <= 0:
                     continue
-                mode = _classify(cls, hybrid)
+                mode = _classify(cls, hybrid, warp_mode=warp_mode)
                 row = per_round.setdefault((cls.key, mode), [cls, mode, 0])
                 row[2] += 1
             for cls, mode, members in per_round.values():

@@ -67,23 +67,28 @@ def test_split_tick_keys_matches_raw_split():
 
 
 def test_stream_registry_pinned_and_exported():
+    import kaboodle_tpu.phasegraph.rng as pg_rng
     import kaboodle_tpu.sparseplane as sp
 
-    table = sp.stream_table()
+    table = pg_rng.stream_table()
     # Double-entry bookkeeping: the live module and keyscope's own table
     # must agree entry-for-entry, in id order.
     assert list(table.items()) == list(rng_rules.KEYSCOPE_STREAMS)
     ids = list(table.values())
     assert ids == list(range(len(ids)))  # dense from 0, append-only order
     assert sp.STREAM_PROXY == 0
-    assert sp.STREAM_GOSSIP == len(ids) - 1
+    assert sp.STREAM_GOSSIP == 5  # the sparse block; tick streams follow
+    assert pg_rng.STREAM_TICK_PROXY == 6
+    assert pg_rng.STREAM_TICK_DROP == len(ids) - 1
+    # The sparseplane shim re-exports the canonical module verbatim.
+    assert sp.stream_table() == table
     assert rng_rules.check_kb602_stream_registry() == []
 
 
 def test_stream_registry_drift_detected(monkeypatch):
-    import kaboodle_tpu.sparseplane.rng as sprng
+    import kaboodle_tpu.phasegraph.rng as pg_rng
 
-    monkeypatch.setattr(sprng, "STREAM_PING", sprng.STREAM_ACK)
+    monkeypatch.setattr(pg_rng, "STREAM_PING", pg_rng.STREAM_ACK)
     findings = rng_rules.check_kb602_stream_registry()
     assert "KB602" in rules_of(findings)
 
@@ -289,10 +294,28 @@ def test_committed_leap_report_schema():
     committed = rng_scan.load_leap_report(REPO / "KEYSCOPE_LEAP.json")
     assert committed is not None
     assert committed["streams"] == dict(rng_rules.KEYSCOPE_STREAMS)
-    # Every entry classifies every sink; the item-2 worklist is non-empty.
-    assert committed["totals"]["chain_coupled"] > 0
+    # Warp 3.0 end state: the item-2 worklist is EMPTY — every engine draw
+    # is a counter-keyed pure function of (key, tick, stream) or the sparse
+    # (seed, cursor, stream) discipline, and the shrink gate keeps it so.
+    assert committed["totals"]["chain_coupled"] == 0
+    assert committed["totals"]["chain_coupled_draw_bytes"] == 0
     assert committed["totals"]["counter_keyed"] > 0
     assert committed["totals"]["impure"] == 0
+
+
+def test_leap_findings_growth_gate(tmp_path):
+    # Commit a chain-free report, then grow a chain-coupled sink: the
+    # ratchet reds with a dedicated "growth" finding alongside staleness.
+    graphs = _toy_graphs()
+    sparse_only = {"toy.sparse": graphs["toy.sparse"]}
+    path = tmp_path / "LEAP.json"
+    rng_scan.write_leap_report(rng_scan.build_leap_report(sparse_only), path)
+    assert rng_scan.leap_findings(sparse_only, path) == []
+    grown = rng_scan.leap_findings(graphs, path)
+    assert [f.symbol for f in grown] == ["growth", "stale"]
+    assert all(f.rule == "KB605" for f in grown)
+    assert any("chain-coupled sink total grew 0 -> 1" in f.message
+               for f in grown)
 
 
 # ---------------------------------------------------------------------------
@@ -325,18 +348,20 @@ def test_explain_covers_every_lane(capsys):
 
 
 def test_mutation_ping_reuse_red_inprocess(monkeypatch, capsys):
-    import kaboodle_tpu.phasegraph.exec as exec_mod
+    import kaboodle_tpu.phasegraph.rng as pg_rng
 
     # Pristine first: the same scoped invocation is clean.
     assert main(["--rng", "--entries", "phasegraph.tick.random",
                  "--no-baseline"]) == 0
     capsys.readouterr()
 
-    def reused(key):
-        ks = jax.random.split(key, 5)
-        return ks[0], ks[1], ks[1], ks[3], ks[4]  # bern <- ping
+    def reused(key, tick):  # bern <- ping (one counter row drawn twice)
+        kp = pg_rng.tick_stream_key(key, tick, pg_rng.STREAM_TICK_PROXY)
+        kping = pg_rng.tick_stream_key(key, tick, pg_rng.STREAM_TICK_PING)
+        kd = pg_rng.tick_stream_key(key, tick, pg_rng.STREAM_TICK_DROP)
+        return kp, kping, kping, kd
 
-    monkeypatch.setattr(exec_mod, "split_tick_keys", reused)
+    monkeypatch.setattr(pg_rng, "tick_draw_keys", reused)
     rc = main(["--rng", "--entries", "phasegraph.tick.random", "--no-baseline"])
     out = capsys.readouterr().out
     assert rc == 1, out
@@ -348,11 +373,11 @@ def test_mutation_ping_reuse_red_inprocess(monkeypatch, capsys):
 
 
 def test_mutation_stream_swap_red_inprocess(monkeypatch, capsys):
-    import kaboodle_tpu.sparseplane.rng as sprng
+    import kaboodle_tpu.phasegraph.rng as pg_rng
 
-    ping, ack = sprng.STREAM_PING, sprng.STREAM_ACK
-    monkeypatch.setattr(sprng, "STREAM_PING", ack)
-    monkeypatch.setattr(sprng, "STREAM_ACK", ping)
+    ping, ack = pg_rng.STREAM_PING, pg_rng.STREAM_ACK
+    monkeypatch.setattr(pg_rng, "STREAM_PING", ack)
+    monkeypatch.setattr(pg_rng, "STREAM_ACK", ping)
     # The swapped ids still trace collision-free (the set is unchanged) —
     # only the registry comparison, which runs on ANY scoped scan, reds.
     rc = main(["--rng", "--entries", "ops.crc32", "--no-baseline"])
@@ -366,11 +391,14 @@ def test_mutation_stream_swap_red_inprocess(monkeypatch, capsys):
 
 
 def test_mutation_const_key_red_inprocess(monkeypatch, capsys):
+    import kaboodle_tpu.phasegraph.rng as pg_rng
     import kaboodle_tpu.sparseplane.rng as sprng
 
-    monkeypatch.setattr(
-        sprng, "stream_key", lambda seed, cursor, stream: jax.random.PRNGKey(0)
-    )
+    # Both the canonical module (stream_uniform's resolution) and the
+    # sparseplane shim (kernel.py's ``sprng.stream_key`` attr access).
+    const = lambda seed, cursor, stream: jax.random.PRNGKey(0)  # noqa: E731
+    monkeypatch.setattr(pg_rng, "stream_key", const)
+    monkeypatch.setattr(sprng, "stream_key", const)
     rc = main(["--rng", "--entries", "phasegraph.tick.sparse", "--no-baseline"])
     out = capsys.readouterr().out
     assert rc == 1, out
@@ -415,8 +443,8 @@ def _mutate(path: pathlib.Path, old: str, new: str) -> None:
 def test_mutation_ping_reuse_red_subprocess(tmp_path):
     dst = _copy_package(tmp_path)
     anchor = (
-        "key_proxy, key_ping, key_bern, key_drop, key_next = "
-        "split_tick_keys(st.key)"
+        "key_proxy, key_ping, key_bern, key_drop = "
+        "pg_rng.tick_draw_keys(st.key, t)"
     )
     _mutate(dst / "phasegraph" / "exec.py", anchor,
             anchor + "\n        key_bern = key_ping")
@@ -429,7 +457,7 @@ def test_mutation_ping_reuse_red_subprocess(tmp_path):
 
 def test_mutation_stream_swap_red_subprocess(tmp_path):
     dst = _copy_package(tmp_path)
-    rng_py = dst / "sparseplane" / "rng.py"
+    rng_py = dst / "phasegraph" / "rng.py"
     _mutate(rng_py, "STREAM_PING = 3", "STREAM_PING = 4")
     _mutate(rng_py, "STREAM_ACK = 4", "STREAM_ACK = 3")
     proc = _run_rng_subprocess(tmp_path, "--entries", "ops.crc32")
@@ -440,7 +468,7 @@ def test_mutation_stream_swap_red_subprocess(tmp_path):
 def test_mutation_const_key_red_subprocess(tmp_path):
     dst = _copy_package(tmp_path)
     _mutate(
-        dst / "sparseplane" / "rng.py",
+        dst / "phasegraph" / "rng.py",
         "    base = jax.random.fold_in(jax.random.PRNGKey(seed), cursor)\n"
         "    return jax.random.fold_in(base, jnp.uint32(stream))",
         "    return jax.random.PRNGKey(0)  # seeded KB603: cursor bypassed",

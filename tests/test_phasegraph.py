@@ -48,7 +48,7 @@ def _op(name, stage, **kw):
 def test_default_graph_validates_and_orders():
     g = build_graph(_cfg(), faulty=True)
     names = [op.name for op in g.ops]
-    assert names.index("rng_split") < names.index("probe_draw")
+    assert names.index("rng_streams") < names.index("probe_draw")
     assert names.index("probe_draw") < names.index("call1") < names.index("finish")
     # the dispatch boundary is real: every prologue op precedes every tail op
     last_prologue = max(names.index(o.name) for o in g.prologue)
